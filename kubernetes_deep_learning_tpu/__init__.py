@@ -1,0 +1,29 @@
+"""TPU-native model-serving framework.
+
+A from-scratch rebuild of the capabilities of
+``alexeygrigorev/kubernetes-deep-learning`` (Flask gateway -> TF-Serving on
+Kubernetes; see /root/reference) designed TPU-first on JAX/XLA:
+
+- ``modelspec``   -- single source of truth for a served model (replaces the
+                     hardcoded names contract of reference model_server.py:40-47)
+- ``models``      -- Flax model zoo (Xception, ResNet50, EfficientNet-B3) with
+                     Keras .h5 weight import for parity with reference convert.py
+- ``ops``         -- host- and device-side image preprocessing
+- ``export``      -- jit-traced StableHLO + params exporter and inspector
+                     (replaces reference convert.py + saved_model_cli)
+- ``runtime``     -- the in-tree TPU model-execution engine + dynamic batcher
+                     (replaces the external TF-Serving C++ binary,
+                     reference tf-serving.dockerfile:1-5)
+- ``serving``     -- model server (RPC tier) and IO gateway with the exact
+                     request/response schema of reference model_server.py:62-66
+- ``parallel``    -- device mesh / sharding helpers; data-parallel serving over
+                     ICI (the NCCL-analog the reference lacks)
+- ``training``    -- fine-tuning loop (sharded train step)
+- ``utils``       -- config, logging, metrics
+"""
+
+__version__ = "0.1.0"
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, get_spec, register_spec
+
+__all__ = ["ModelSpec", "get_spec", "register_spec", "__version__"]

@@ -1,0 +1,65 @@
+"""Model zoo registry: ModelSpec.family -> flax module factory.
+
+``build_forward`` is the one entry point the rest of the framework uses: it
+returns a pure function ``f(variables, uint8_images) -> float32 logits`` with
+normalization fused on-device (see ops.preprocess.normalize) -- the unit the
+exporter traces and the serving engine compiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+
+def create_model(spec: ModelSpec, dtype: Any = None):
+    """Instantiate the flax module for a spec (dtype = compute dtype)."""
+    if spec.family == "xception":
+        from kubernetes_deep_learning_tpu.models.xception import Xception
+
+        return Xception(spec.num_classes, head_hidden=spec.head_hidden, dtype=dtype)
+    if spec.family == "resnet50":
+        from kubernetes_deep_learning_tpu.models.resnet import ResNet50
+
+        return ResNet50(spec.num_classes, dtype=dtype)
+    if spec.family == "efficientnet-b3":
+        from kubernetes_deep_learning_tpu.models.efficientnet import EfficientNetB3
+
+        return EfficientNetB3(spec.num_classes, dtype=dtype)
+    raise KeyError(f"unknown model family {spec.family!r}")
+
+
+def init_variables(spec: ModelSpec, seed: int = 0, dtype: Any = None):
+    """Random-init variables with the spec's input shape (for tests/bench)."""
+    import jax
+
+    model = create_model(spec, dtype=dtype)
+    dummy = jnp.zeros((1, *spec.input_shape), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy)
+
+
+def build_forward(
+    spec: ModelSpec, dtype: Any = jnp.bfloat16
+) -> Callable[[Any, Any], Any]:
+    """Return ``f(variables, images) -> logits`` ready for jit/export.
+
+    ``images`` may be uint8 HWC batches straight off the wire (the gateway
+    ships uint8; see serving.protocol) or pre-normalized float32.  The uint8
+    path normalizes on device so the scale/shift fuses into the first conv.
+    Logits are returned as float32 regardless of compute dtype.
+    """
+    model = create_model(spec, dtype=dtype)
+
+    def forward(variables, images):
+        if images.dtype == jnp.uint8:
+            x = normalize(images, spec.preprocessing)
+        else:
+            x = images.astype(jnp.float32)
+        logits = model.apply(variables, x, train=False)
+        return logits.astype(jnp.float32)
+
+    return forward

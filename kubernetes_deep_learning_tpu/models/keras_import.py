@@ -1,0 +1,171 @@
+"""Import Keras .h5 weights into the flax model zoo.
+
+The reference's model artifact is a Keras .h5 (``xception_v4_large_08_0.894.h5``,
+reference guide.md:176) which ``convert.py`` re-saves as a TF SavedModel.  Here
+the equivalent step loads that .h5 **directly** into flax params -- no
+TensorFlow in the loop -- so the reference's expected logits
+(reference guide.md:623-625) are reproducible from the same artifact.
+
+Keras layer names are preserved by the flax modules for named layers
+(block1_conv1, ...); layers Keras auto-names (the four residual 1x1 convs and
+their BatchNorms, and the head Dense layers) are matched structurally by
+weight shape, which is unique per site in Xception.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+
+# Residual 1x1 conv kernel shape -> our module name (unique per site).
+_XCEPTION_RES_CONVS = {
+    (1, 1, 64, 128): "block2_res_conv",
+    (1, 1, 128, 256): "block3_res_conv",
+    (1, 1, 256, 728): "block4_res_conv",
+    (1, 1, 728, 1024): "block13_res_conv",
+}
+# Residual BatchNorm channel count -> our module name.
+_XCEPTION_RES_BNS = {128: "block2_res_bn", 256: "block3_res_bn", 728: "block4_res_bn", 1024: "block13_res_bn"}
+
+
+def read_keras_h5(path: str) -> dict[str, dict[str, np.ndarray]]:
+    """Flatten a Keras .h5 into {layer_name: {weight_name: array}}.
+
+    Walks the file recursively so both flat models and nested-submodel layouts
+    (transfer learning: model_weights/xception/<layer>/<weight>:0) work.
+    """
+    import h5py
+
+    layers: dict[str, dict[str, np.ndarray]] = {}
+
+    def visit(name: str, obj) -> None:
+        if not isinstance(obj, h5py.Dataset):
+            return
+        parts = name.split("/")
+        weight = parts[-1].split(":")[0]
+        layer = parts[-2] if len(parts) >= 2 else parts[-1]
+        layers.setdefault(layer, {})[weight] = np.asarray(obj)
+
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        root.visititems(visit)
+    return layers
+
+
+def _bn(layer: dict[str, np.ndarray]):
+    params = {"scale": layer["gamma"], "bias": layer["beta"]}
+    stats = {"mean": layer["moving_mean"], "var": layer["moving_variance"]}
+    return params, stats
+
+
+def _sepconv(layer: dict[str, np.ndarray]):
+    dw = layer["depthwise_kernel"]  # keras (kh, kw, c_in, 1)
+    pw = layer["pointwise_kernel"]  # (1, 1, c_in, c_out)
+    return {
+        "depthwise": {"kernel": np.transpose(dw, (0, 1, 3, 2))},  # flax (kh, kw, 1, c_in)
+        "pointwise": {"kernel": pw},
+    }
+
+
+def _dense_layers_in_order(layers: dict[str, dict[str, np.ndarray]]):
+    """Auto-named head Dense layers (dense, dense_1, ...) in creation order."""
+    found = []
+    for name, w in layers.items():
+        m = re.fullmatch(r"dense(?:_(\d+))?", name)
+        if m and "kernel" in w and w["kernel"].ndim == 2:
+            found.append((int(m.group(1) or 0), name, w))
+    return [(name, w) for _, name, w in sorted(found)]
+
+
+def xception_variables_from_keras(
+    spec: ModelSpec, layers: dict[str, dict[str, np.ndarray]]
+):
+    """Build flax variables for models.xception.Xception from Keras weights."""
+    params: dict = {}
+    stats: dict = {}
+
+    def put_bn(name: str, layer):
+        p, s = _bn(layer)
+        params[name] = p
+        stats[name] = s
+
+    # Explicitly-named Keras layers map one-to-one.
+    for name, w in layers.items():
+        if re.fullmatch(r"block\d+_conv\d", name):
+            params[name] = {"kernel": w["kernel"]}
+        elif re.fullmatch(r"block\d+_sepconv\d", name):
+            params[name] = _sepconv(w)
+        elif re.fullmatch(r"block\d+_(conv|sepconv)\d_bn", name):
+            put_bn(name, w)
+
+    # Auto-named residual convs + BNs: match by shape (unique per site).
+    for name, w in layers.items():
+        if "kernel" in w and w["kernel"].ndim == 4 and w["kernel"].shape in _XCEPTION_RES_CONVS:
+            params[_XCEPTION_RES_CONVS[w["kernel"].shape]] = {"kernel": w["kernel"]}
+        elif "gamma" in w and not name.startswith("block"):
+            channels = w["gamma"].shape[0]
+            target = _XCEPTION_RES_BNS.get(channels)
+            if target is not None:
+                put_bn(target, w)
+
+    # Head: auto-named Dense layers in creation order; last one is logits.
+    denses = _dense_layers_in_order(layers)
+    if not denses:
+        raise ValueError("no Dense layers found in .h5 (expected classifier head)")
+    head: dict = {}
+    *hidden, (_, logits_w) = denses
+    for i, (_, w) in enumerate(hidden):
+        head[f"hidden_{i}"] = {"kernel": w["kernel"], "bias": w["bias"]}
+    head["logits"] = {"kernel": logits_w["kernel"], "bias": logits_w["bias"]}
+    params["head"] = head
+
+    hidden_sizes = tuple(w["kernel"].shape[1] for _, w in hidden)
+    if hidden_sizes != spec.head_hidden:
+        raise ValueError(
+            f".h5 head hidden sizes {hidden_sizes} do not match spec "
+            f"{spec.head_hidden}; fix the ModelSpec to match the artifact"
+        )
+    if logits_w["kernel"].shape[1] != spec.num_classes:
+        raise ValueError(
+            f".h5 logits width {logits_w['kernel'].shape[1]} != {spec.num_classes} labels"
+        )
+
+    variables = {"params": params, "batch_stats": stats}
+    _check_structure(spec, variables)
+    return variables
+
+
+def _check_structure(spec: ModelSpec, variables) -> None:
+    """Verify imported tree matches the module's own init structure."""
+    import jax
+
+    from kubernetes_deep_learning_tpu.models import init_variables
+
+    expected = jax.eval_shape(lambda: init_variables(spec, seed=0))
+
+    def paths_to_shapes(tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        return {jax.tree_util.keystr(k): tuple(v.shape) for k, v in flat}
+
+    exp_map = paths_to_shapes(expected)
+    got_map = paths_to_shapes(variables)
+    missing = sorted(set(exp_map) - set(got_map))
+    extra = sorted(set(got_map) - set(exp_map))
+    bad = [k for k in exp_map.keys() & got_map.keys() if tuple(exp_map[k]) != tuple(got_map[k])]
+    if missing or extra or bad:
+        raise ValueError(
+            "imported Keras weights do not match model structure:\n"
+            f"  missing: {missing[:10]}\n  unexpected: {extra[:10]}\n"
+            f"  shape mismatch: {[(k, exp_map[k], got_map[k]) for k in bad[:10]]}"
+        )
+
+
+def load_keras_h5(spec: ModelSpec, path: str):
+    """One-call import: .h5 file -> flax variables for ``spec``."""
+    layers = read_keras_h5(path)
+    if spec.family == "xception":
+        return xception_variables_from_keras(spec, layers)
+    raise NotImplementedError(f"Keras import not implemented for {spec.family!r}")

@@ -1,0 +1,79 @@
+"""Shared building blocks for the model zoo (flax.linen).
+
+Design notes (TPU-first): all convolutions are expressed as ``nn.Conv`` so XLA
+lowers them onto the MXU; depthwise separable convolution is depthwise
+(``feature_group_count = C_in``) followed by a 1x1 pointwise conv, the exact
+decomposition Keras' ``SeparableConv2D`` uses, so weights from the reference's
+.h5 artifact (reference convert.py:4) map one-to-one.  Compute dtype is a
+module argument (bf16 for serving); parameters stay f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+
+# Keras BatchNormalization default epsilon (TF 2.3), needed for logit parity.
+KERAS_BN_EPS = 1e-3
+
+
+class SeparableConv2D(nn.Module):
+    """Depthwise 3x3 + pointwise 1x1, both bias-free (Keras SeparableConv2D)."""
+
+    features: int
+    kernel: tuple[int, int] = (3, 3)
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        c_in = x.shape[-1]
+        x = nn.Conv(
+            c_in,
+            self.kernel,
+            feature_group_count=c_in,
+            use_bias=False,
+            padding="SAME",
+            dtype=self.dtype,
+            name="depthwise",
+        )(x)
+        x = nn.Conv(
+            self.features, (1, 1), use_bias=False, dtype=self.dtype, name="pointwise"
+        )(x)
+        return x
+
+
+def batch_norm(train: bool, dtype: Any, name: str, eps: float = KERAS_BN_EPS):
+    return nn.BatchNorm(
+        use_running_average=not train,
+        epsilon=eps,
+        momentum=0.99,
+        dtype=dtype,
+        name=name,
+    )
+
+
+class ClassifierHead(nn.Module):
+    """Global-average-pool head: optional hidden Dense layers, then logits.
+
+    Mirrors the reference's transfer-learning head (GlobalAveragePooling2D ->
+    Dense(inner, relu) -> Dropout -> Dense(10); reference guide.md:176's
+    xception_v4_large artifact).  Dropout is inference-inert and only applied
+    when ``train`` and ``dropout_rate > 0``.
+    """
+
+    num_classes: int
+    hidden: tuple[int, ...] = ()
+    dropout_rate: float = 0.0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (N, H, W, C) -> global average pool over spatial dims.
+        x = x.mean(axis=(1, 2))
+        for i, width in enumerate(self.hidden):
+            x = nn.Dense(width, dtype=self.dtype, name=f"hidden_{i}")(x)
+            x = nn.relu(x)
+            if train and self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="logits")(x)

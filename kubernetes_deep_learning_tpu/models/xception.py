@@ -1,0 +1,95 @@
+"""Xception in flax.linen, matching the Keras architecture weight-for-weight.
+
+This is the flagship model: the reference serves an Xception-based 10-class
+clothing classifier with input contract ``input_8 (-1,299,299,3) f32 ->
+dense_7 (-1,10) f32`` (reference guide.md:220-231).  Module names mirror Keras
+layer names (block1_conv1, block4_sepconv2_bn, ...) so the .h5 importer in
+``models.keras_import`` can map weights structurally.
+
+Architecture (Chollet 2017, as implemented by keras.applications.xception):
+entry flow (2 convs + 3 strided separable residual blocks), middle flow
+(8 identical 728-wide residual blocks), exit flow (strided block + 1536/2048
+separable convs), global average pool, classifier head.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+
+from kubernetes_deep_learning_tpu.models.layers import (
+    ClassifierHead,
+    SeparableConv2D,
+    batch_norm,
+)
+
+# Entry-flow residual block widths; block index -> features.
+_ENTRY_BLOCKS = ((2, 128), (3, 256), (4, 728))
+_MIDDLE_BLOCKS = range(5, 13)  # blocks 5..12, 728 features each
+
+
+class Xception(nn.Module):
+    num_classes: int
+    head_hidden: tuple[int, ...] = ()
+    dropout_rate: float = 0.0
+    dtype: Any = None  # compute dtype; params stay float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(batch_norm, train, self.dtype)
+        sep = partial(SeparableConv2D, dtype=self.dtype)
+        pool = partial(nn.max_pool, window_shape=(3, 3), strides=(2, 2), padding="SAME")
+
+        # --- Entry flow ---
+        x = conv(32, (3, 3), strides=2, padding="VALID", name="block1_conv1")(x)
+        x = nn.relu(bn("block1_conv1_bn")(x))
+        x = conv(64, (3, 3), padding="VALID", name="block1_conv2")(x)
+        x = nn.relu(bn("block1_conv2_bn")(x))
+
+        for idx, feat in _ENTRY_BLOCKS:
+            residual = conv(feat, (1, 1), strides=2, padding="SAME", name=f"block{idx}_res_conv")(x)
+            residual = bn(f"block{idx}_res_bn")(residual)
+            if idx > 2:  # block2 has no leading activation (Keras quirk)
+                x = nn.relu(x)
+            x = sep(feat, name=f"block{idx}_sepconv1")(x)
+            x = bn(f"block{idx}_sepconv1_bn")(x)
+            x = nn.relu(x)
+            x = sep(feat, name=f"block{idx}_sepconv2")(x)
+            x = bn(f"block{idx}_sepconv2_bn")(x)
+            x = pool(x) + residual
+
+        # --- Middle flow: 8 residual blocks of 3 separable convs ---
+        for idx in _MIDDLE_BLOCKS:
+            residual = x
+            for j in (1, 2, 3):
+                x = nn.relu(x)
+                x = sep(728, name=f"block{idx}_sepconv{j}")(x)
+                x = bn(f"block{idx}_sepconv{j}_bn")(x)
+            x = x + residual
+
+        # --- Exit flow ---
+        residual = conv(1024, (1, 1), strides=2, padding="SAME", name="block13_res_conv")(x)
+        residual = bn("block13_res_bn")(residual)
+        x = nn.relu(x)
+        x = sep(728, name="block13_sepconv1")(x)
+        x = bn("block13_sepconv1_bn")(x)
+        x = nn.relu(x)
+        x = sep(1024, name="block13_sepconv2")(x)
+        x = bn("block13_sepconv2_bn")(x)
+        x = pool(x) + residual
+
+        x = sep(1536, name="block14_sepconv1")(x)
+        x = nn.relu(bn("block14_sepconv1_bn")(x))
+        x = sep(2048, name="block14_sepconv2")(x)
+        x = nn.relu(bn("block14_sepconv2_bn")(x))
+
+        return ClassifierHead(
+            self.num_classes,
+            hidden=self.head_hidden,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="head",
+        )(x, train=train)

@@ -1,0 +1,133 @@
+"""ModelSpec: the single source of truth for a served model.
+
+The reference system splits the model contract across four places that must be
+kept in sync by hand: the exporter output inspected with ``saved_model_cli``
+(reference guide.md:199-236), hardcoded tensor/signature names in the gateway
+(reference model_server.py:40-47), a hardcoded label list
+(reference model_server.py:21-32), and a hardcoded preprocessor config
+(reference model_server.py:18).  Here all of that lives in one dataclass that
+the exporter, model server, and gateway all consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Everything needed to export, serve, and query one model."""
+
+    name: str                       # served model name, e.g. "clothing-model"
+    family: str                     # architecture family key in models.registry
+    input_shape: tuple[int, int, int]   # (H, W, C), batch dim excluded
+    labels: tuple[str, ...]         # output class labels, index-aligned
+    preprocessing: str = "tf"       # "tf" | "caffe" | "torch" | "none"
+    resize_filter: str = "bilinear"  # "bilinear" | "nearest" (host resize filter)
+    input_dtype: str = "uint8"      # wire dtype gateway -> server (normalize on device)
+    input_name: str = "image"       # request tensor key
+    output_name: str = "scores"     # response tensor key
+    head_hidden: tuple[int, ...] = ()   # hidden Dense sizes between pool and logits
+    description: str = ""
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.labels)
+
+    @property
+    def batched_shape(self) -> tuple[int, ...]:
+        return (-1, *self.input_shape)
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        return json.dumps(d, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ModelSpec":
+        d: dict[str, Any] = json.loads(s)
+        d["input_shape"] = tuple(d["input_shape"])
+        d["labels"] = tuple(d["labels"])
+        d["head_hidden"] = tuple(d.get("head_hidden", ()))
+        return cls(**d)
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register_spec(spec: ModelSpec) -> ModelSpec:
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get_spec(name: str) -> ModelSpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model spec {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def list_specs() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# The flagship model: the reference's 10-class clothing classifier
+# (labels from reference model_server.py:21-32, input contract from
+# reference guide.md:220-231: (-1, 299, 299, 3) f32 -> (-1, 10) f32).
+# head_hidden=(100,) mirrors the bookcamp transfer-learning head that
+# produced xception_v4_large_08_0.894.h5 (reference guide.md:176).
+CLOTHING_MODEL = register_spec(
+    ModelSpec(
+        name="clothing-model",
+        family="xception",
+        input_shape=(299, 299, 3),
+        labels=(
+            "dress",
+            "hat",
+            "longsleeve",
+            "outwear",
+            "pants",
+            "shirt",
+            "shoes",
+            "shorts",
+            "skirt",
+            "t-shirt",
+        ),
+        preprocessing="tf",
+        # keras-image-helper (the reference gateway's preprocessor,
+        # reference model_server.py:18) resizes with NEAREST; match it so the
+        # reference's expected logits (guide.md:623-625) reproduce exactly.
+        resize_filter="nearest",
+        head_hidden=(100,),
+        description="Xception clothing classifier (reference flagship model)",
+    )
+)
+
+_IMAGENET_LABELS = tuple(f"class_{i}" for i in range(1000))
+
+# BASELINE.json config 3: ResNet50/ImageNet served via the same gateway path.
+RESNET50_IMAGENET = register_spec(
+    ModelSpec(
+        name="resnet50-imagenet",
+        family="resnet50",
+        input_shape=(224, 224, 3),
+        labels=_IMAGENET_LABELS,
+        preprocessing="caffe",
+        description="ResNet50 ImageNet classifier",
+    )
+)
+
+# BASELINE.json config 4: EfficientNet-B3 with server-side dynamic batching.
+EFFICIENTNET_B3_IMAGENET = register_spec(
+    ModelSpec(
+        name="efficientnet-b3-imagenet",
+        family="efficientnet-b3",
+        input_shape=(300, 300, 3),
+        labels=_IMAGENET_LABELS,
+        preprocessing="torch",
+        description="EfficientNet-B3 ImageNet classifier",
+    )
+)

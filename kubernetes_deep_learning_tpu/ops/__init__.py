@@ -1,0 +1,15 @@
+from kubernetes_deep_learning_tpu.ops.preprocess import (
+    decode_image,
+    fetch_image_bytes,
+    normalize,
+    preprocess_bytes,
+    resize_uint8,
+)
+
+__all__ = [
+    "decode_image",
+    "fetch_image_bytes",
+    "normalize",
+    "preprocess_bytes",
+    "resize_uint8",
+]

@@ -1,0 +1,120 @@
+"""Image preprocessing: host-side decode/resize, device-side normalization.
+
+The reference delegates all of this to the ``keras-image-helper`` package
+(reference model_server.py:8,18,53: ``create_preprocessor('xception',
+target_size=(299, 299)).from_url(url)``), which downloads the image, resizes
+with PIL, and normalizes on the *host*.  TPU-first redesign:
+
+- host side does only what must be on host: HTTP fetch, JPEG/PNG decode, and
+  resize to the model's input resolution, staying in **uint8** (3x smaller on
+  the gateway->server wire than f32);
+- normalization (the elementwise scale/shift) runs **on device**, where XLA
+  fuses it into the first convolution -- it never costs a separate HBM pass.
+
+A C++ fast path for resize lives in native/ (see ``_native.resize`` below);
+PIL is the fallback so the package works without the compiled library.
+"""
+
+from __future__ import annotations
+
+import io
+import urllib.request
+
+import numpy as np
+
+try:  # optional C++ fast path (native/preprocess.cc)
+    from kubernetes_deep_learning_tpu.ops import _native
+except Exception:  # pragma: no cover - native lib not built
+    _native = None
+
+# Normalization constants, index-aligned with `modelspec.ModelSpec.preprocessing`.
+#   tf    : x / 127.5 - 1            (Keras "tf" mode; Xception, reference
+#           keras-image-helper behavior for create_preprocessor('xception'))
+#   caffe : BGR, subtract ImageNet channel means (Keras "caffe" mode; ResNet50)
+#   torch : x / 255, ImageNet mean/std (EfficientNet via torchvision convention)
+_CAFFE_MEAN_BGR = np.array([103.939, 116.779, 123.68], np.float32)
+_TORCH_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+_TORCH_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+USER_AGENT = "kdlt-gateway/0.1"
+FETCH_TIMEOUT_S = 10.0
+MAX_FETCH_BYTES = 32 * 1024 * 1024  # reject pathological/streaming URLs
+
+
+def fetch_image_bytes(
+    url: str, timeout: float = FETCH_TIMEOUT_S, max_bytes: int = MAX_FETCH_BYTES
+) -> bytes:
+    """Download raw image bytes (the reference gateway's .from_url step).
+
+    The read is bounded: an attacker-supplied URL pointing at a multi-GB or
+    endless stream must not OOM the gateway (the timeout only bounds
+    inactivity, not transferred bytes).
+    """
+    req = urllib.request.Request(url, headers={"User-Agent": USER_AGENT})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        data = resp.read(max_bytes + 1)
+    if len(data) > max_bytes:
+        raise ValueError(f"image at {url!r} exceeds {max_bytes} byte limit")
+    return data
+
+
+def decode_image(data: bytes) -> np.ndarray:
+    """Decode JPEG/PNG bytes to an RGB uint8 HWC array."""
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as img:
+        if img.mode != "RGB":
+            img = img.convert("RGB")
+        return np.asarray(img, dtype=np.uint8)
+
+
+def resize_uint8(
+    img: np.ndarray, size: tuple[int, int], filter: str = "bilinear"
+) -> np.ndarray:
+    """Resize an RGB uint8 HWC array to (H, W).
+
+    ``filter`` comes from ModelSpec.resize_filter: the clothing model uses
+    "nearest" because keras-image-helper (the reference's preprocessor,
+    reference model_server.py:18) resizes with Image.NEAREST, and the filter
+    choice shifts logits far beyond numerical tolerance.  Uses the C++ kernel
+    when built (bilinear only), else PIL.  Both paths produce uint8 HWC.
+    """
+    h, w = int(size[0]), int(size[1])
+    if img.shape[0] == h and img.shape[1] == w:
+        return np.ascontiguousarray(img)
+    if filter == "bilinear" and _native is not None:
+        return _native.resize_bilinear(img, h, w)
+    from PIL import Image
+
+    filters = {"bilinear": Image.BILINEAR, "nearest": Image.NEAREST}
+    pil = Image.fromarray(img)
+    return np.asarray(pil.resize((w, h), filters[filter]), dtype=np.uint8)
+
+
+def preprocess_bytes(
+    data: bytes, size: tuple[int, int], *, filter: str = "bilinear"
+) -> np.ndarray:
+    """bytes -> resized RGB uint8 HWC; the full host-side gateway pipeline."""
+    return resize_uint8(decode_image(data), size, filter)
+
+
+def normalize(x, mode: str):
+    """uint8/float image batch -> normalized float input, in jax or numpy.
+
+    Works on both np.ndarray and jax.Array (pure elementwise ops); inside jit
+    XLA fuses this into the consuming convolution.
+    """
+    if mode == "none":
+        return x
+    # Keep jax out of the pure-numpy (gateway host) path: jax init is heavy
+    # and the gateway should not pay it. astype(np.float32) works for both.
+    x = x.astype(np.float32)
+    if mode == "tf":
+        return x / 127.5 - 1.0
+    if mode == "caffe":
+        # RGB -> BGR, then subtract channel means (no scaling).
+        x = x[..., ::-1]
+        return x - _CAFFE_MEAN_BGR
+    if mode == "torch":
+        return (x / 255.0 - _TORCH_MEAN) / _TORCH_STD
+    raise ValueError(f"unknown preprocessing mode {mode!r}")

@@ -1,0 +1,26 @@
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, get_spec
+
+
+def test_clothing_spec_matches_reference_contract():
+    # Contract from reference guide.md:220-231 and model_server.py:21-32.
+    spec = get_spec("clothing-model")
+    assert spec.input_shape == (299, 299, 3)
+    assert spec.num_classes == 10
+    assert spec.labels[4] == "pants"
+    assert spec.labels == (
+        "dress", "hat", "longsleeve", "outwear", "pants",
+        "shirt", "shoes", "shorts", "skirt", "t-shirt",
+    )
+    assert spec.preprocessing == "tf"
+
+
+def test_spec_json_roundtrip():
+    spec = get_spec("clothing-model")
+    again = ModelSpec.from_json(spec.to_json())
+    assert again == spec
+
+
+def test_registry_has_baseline_configs():
+    # BASELINE.json configs 3 and 4.
+    assert get_spec("resnet50-imagenet").family == "resnet50"
+    assert get_spec("efficientnet-b3-imagenet").family == "efficientnet-b3"

@@ -1,0 +1,74 @@
+import io
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.ops import preprocess
+
+
+def _png_bytes(h=40, w=60):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    return buf.getvalue(), img
+
+
+def test_decode_roundtrip():
+    data, img = _png_bytes()
+    out = preprocess.decode_image(data)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_resize_shapes():
+    _, img = _png_bytes()
+    out = preprocess.resize_uint8(img, (299, 299))
+    assert out.shape == (299, 299, 3) and out.dtype == np.uint8
+    same = preprocess.resize_uint8(img, img.shape[:2])
+    np.testing.assert_array_equal(same, img)
+
+
+def test_preprocess_bytes_pipeline():
+    data, _ = _png_bytes()
+    out = preprocess.preprocess_bytes(data, (128, 128))
+    assert out.shape == (128, 128, 3) and out.dtype == np.uint8
+
+
+def test_normalize_tf_mode_matches_reference():
+    # Xception "tf" mode: x/127.5 - 1, the keras-image-helper behavior the
+    # reference gateway applies (reference model_server.py:18).
+    x = np.array([[0, 127.5, 255]], np.float32)
+    out = preprocess.normalize(x, "tf")
+    np.testing.assert_allclose(out, [[-1.0, 0.0, 1.0]], atol=1e-6)
+
+
+def test_normalize_caffe_bgr_and_means():
+    x = np.zeros((1, 1, 3), np.float32)
+    out = preprocess.normalize(x, "caffe")
+    np.testing.assert_allclose(out[0, 0], -preprocess._CAFFE_MEAN_BGR)
+
+
+def test_normalize_torch():
+    x = np.full((1, 1, 3), 255.0, np.float32)
+    out = preprocess.normalize(x, "torch")
+    np.testing.assert_allclose(
+        out[0, 0], (1.0 - preprocess._TORCH_MEAN) / preprocess._TORCH_STD, rtol=1e-5
+    )
+
+
+def test_normalize_jax_matches_numpy():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 256, size=(2, 4, 4, 3), dtype=np.uint8)
+    for mode in ("tf", "caffe", "torch"):
+        a = preprocess.normalize(x.astype(np.float32), mode)
+        b = np.asarray(preprocess.normalize(jnp.asarray(x, jnp.float32), mode))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_normalize_unknown_mode():
+    with pytest.raises(ValueError):
+        preprocess.normalize(np.zeros((1,), np.float32), "bogus")

@@ -1,0 +1,54 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_deep_learning_tpu.models import build_forward, create_model, init_variables
+
+
+def test_forward_shape_and_dtype(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    fwd = build_forward(tiny_spec, dtype=None)
+    x = np.zeros((2, *tiny_spec.input_shape), np.uint8)
+    logits = jax.jit(fwd)(variables, x)
+    assert logits.shape == (2, tiny_spec.num_classes)
+    assert logits.dtype == jnp.float32
+
+
+def test_uint8_and_prenormalized_paths_agree(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    fwd = build_forward(tiny_spec, dtype=None)
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, size=(1, *tiny_spec.input_shape), dtype=np.uint8)
+    f32 = u8.astype(np.float32) / 127.5 - 1.0
+    a = jax.jit(fwd)(variables, u8)
+    b = jax.jit(fwd)(variables, f32)
+    # The two entry dtypes compile separately; XLA fuses the normalize into
+    # downstream convs differently, so allow fusion-level f32 rounding drift.
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=5e-3)
+
+
+def test_param_count_matches_keras_xception():
+    # keras.applications.Xception base (include_top=False) has 20,861,480
+    # params; our backbone must match it weight-for-weight for .h5 import.
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+
+    spec = get_spec("clothing-model")
+    model = create_model(spec)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    )
+    total = sum(
+        int(np.prod(a.shape))
+        for a in jax.tree.leaves(variables)
+    )
+    head = 2048 * 100 + 100 + 100 * 10 + 10  # hidden_0 + logits
+    assert total == 20_861_480 + head
+
+
+def test_batchnorm_inference_uses_running_stats(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    fwd = build_forward(tiny_spec, dtype=None)
+    x = np.zeros((1, *tiny_spec.input_shape), np.uint8)
+    a = jax.jit(fwd)(variables, x)
+    b = jax.jit(fwd)(variables, x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
