@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Benchmark the flagship serving path on the local accelerator.
+
+Measures the model tier's raw throughput/latency (the hot loop the reference
+delegates to TF-Serving's C++ binary) on the Xception clothing classifier:
+batch-swept images/sec plus p50/p99 single-dispatch latency, against the
+BASELINE.json target of >=4000 images/sec/chip at p50 <= 15 ms.
+
+Prints ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+Detail goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+TARGET_IMG_S = 4000.0  # BASELINE.json north star: >=4000 img/s/chip on v5e
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_forward(batch_sizes, iters, warmup, dtype_name):
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+
+    spec = get_spec("clothing-model")
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    dev = jax.devices()[0]
+    log(f"device: {dev}, compute dtype: {dtype_name}")
+
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    fwd = jax.jit(build_forward(spec, dtype=dtype))
+
+    rng = np.random.default_rng(0)
+    results = {}
+    for b in batch_sizes:
+        x = jax.device_put(
+            rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
+        )
+        t0 = time.perf_counter()
+        jax.block_until_ready(fwd(variables, x))
+        compile_s = time.perf_counter() - t0
+        for _ in range(warmup):
+            jax.block_until_ready(fwd(variables, x))
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fwd(variables, x))
+            times.append(time.perf_counter() - t0)
+        times = np.array(times)
+        img_s = b / times.mean()
+        results[b] = {
+            "img_per_s": float(img_s),
+            "p50_ms": float(np.percentile(times, 50) * 1e3),
+            "p99_ms": float(np.percentile(times, 99) * 1e3),
+            "compile_s": float(compile_s),
+        }
+        log(
+            f"batch {b:4d}: {img_s:9.1f} img/s  "
+            f"p50 {results[b]['p50_ms']:7.2f} ms  p99 {results[b]['p99_ms']:7.2f} ms  "
+            f"(compile {compile_s:.1f}s)"
+        )
+    return spec, results
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", default="1,2,4,8,16,32,64,128")
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    args = p.parse_args()
+
+    batch_sizes = [int(b) for b in args.batches.split(",")]
+    spec, results = bench_forward(batch_sizes, args.iters, args.warmup, args.dtype)
+
+    # Headline: batch=32 throughput on one chip (BASELINE.json config 2).
+    headline_batch = 32 if 32 in results else max(results)
+    value = results[headline_batch]["img_per_s"]
+    out = {
+        "metric": f"xception-clothing images/sec/chip (batch={headline_batch}, "
+        f"{args.dtype}, p50={results[headline_batch]['p50_ms']:.2f}ms, "
+        f"p99={results[headline_batch]['p99_ms']:.2f}ms)",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / TARGET_IMG_S, 3),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+from kubernetes_deep_learning_tpu.export.artifact import (
+    ModelArtifact,
+    latest_version,
+    load_artifact,
+    scan_versions,
+)
+from kubernetes_deep_learning_tpu.export.exporter import export_model
+
+__all__ = [
+    "ModelArtifact",
+    "export_model",
+    "latest_version",
+    "load_artifact",
+    "scan_versions",
+]

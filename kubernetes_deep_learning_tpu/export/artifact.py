@@ -1,0 +1,116 @@
+"""Versioned model-artifact layout: ``<root>/<model-name>/<version>/``.
+
+Mirrors the reference's TF-Serving convention of ``/models/<name>/<n>``
+(reference tf-serving.dockerfile:5) where the server scans for the highest
+numeric version directory.  An artifact directory contains:
+
+- ``spec.json``        -- the ModelSpec (single source of truth; replaces the
+                          reference's saved_model_cli-then-hardcode contract,
+                          reference guide.md:199-236)
+- ``params.msgpack``   -- flax variables ({params, batch_stats}), float32
+- ``module.stablehlo`` -- jax.export-serialized StableHLO of the forward fn
+                          with a symbolic batch dimension (the SavedModel
+                          equivalent, per BASELINE.json north star)
+- ``metadata.json``    -- export provenance (jax version, platforms, dtype)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+
+SPEC_FILE = "spec.json"
+PARAMS_FILE = "params.msgpack"
+MODULE_FILE = "module.stablehlo"
+META_FILE = "metadata.json"
+
+
+@dataclasses.dataclass
+class ModelArtifact:
+    spec: ModelSpec
+    variables: Any                 # nested dict of np arrays
+    exported_bytes: bytes | None   # serialized jax.export.Exported, if present
+    metadata: dict
+    path: str = ""
+
+    _exported = None  # lazily deserialized Exported
+
+    @property
+    def exported(self):
+        """The deserialized jax.export.Exported module (lazy)."""
+        if self._exported is None:
+            if self.exported_bytes is None:
+                raise ValueError(f"artifact at {self.path!r} has no StableHLO module")
+            from jax import export as jax_export
+
+            self._exported = jax_export.deserialize(self.exported_bytes)
+        return self._exported
+
+
+def save_artifact(
+    directory: str,
+    spec: ModelSpec,
+    variables: Any,
+    exported_bytes: bytes | None,
+    metadata: dict,
+) -> str:
+    import flax.serialization
+
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, SPEC_FILE), "w") as f:
+        f.write(spec.to_json())
+    with open(os.path.join(directory, PARAMS_FILE), "wb") as f:
+        f.write(flax.serialization.to_bytes(variables))
+    if exported_bytes is not None:
+        with open(os.path.join(directory, MODULE_FILE), "wb") as f:
+            f.write(exported_bytes)
+    with open(os.path.join(directory, META_FILE), "w") as f:
+        json.dump(metadata, f, indent=2, sort_keys=True)
+    return directory
+
+
+def load_artifact(directory: str) -> ModelArtifact:
+    import flax.serialization
+
+    with open(os.path.join(directory, SPEC_FILE)) as f:
+        spec = ModelSpec.from_json(f.read())
+    with open(os.path.join(directory, PARAMS_FILE), "rb") as f:
+        # msgpack_restore needs no template: restores a plain nested dict.
+        variables = flax.serialization.msgpack_restore(f.read())
+    exported_bytes = None
+    module_path = os.path.join(directory, MODULE_FILE)
+    if os.path.exists(module_path):
+        with open(module_path, "rb") as f:
+            exported_bytes = f.read()
+    metadata = {}
+    meta_path = os.path.join(directory, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            metadata = json.load(f)
+    return ModelArtifact(spec, variables, exported_bytes, metadata, path=directory)
+
+
+def scan_versions(root: str, name: str) -> list[int]:
+    """Numeric version dirs under <root>/<name>/, ascending (TF-Serving rule)."""
+    model_dir = os.path.join(root, name)
+    if not os.path.isdir(model_dir):
+        return []
+    versions = [
+        int(d) for d in os.listdir(model_dir)
+        if re.fullmatch(r"\d+", d) and os.path.isdir(os.path.join(model_dir, d))
+    ]
+    return sorted(versions)
+
+
+def latest_version(root: str, name: str) -> int | None:
+    versions = scan_versions(root, name)
+    return versions[-1] if versions else None
+
+
+def version_dir(root: str, name: str, version: int) -> str:
+    return os.path.join(root, name, str(version))

@@ -1,0 +1,118 @@
+"""Model exporter: the reference ``convert.py`` equivalent, TPU-native.
+
+The reference exports Keras .h5 -> TF SavedModel (reference convert.py:4-6).
+Here the export is jax.export-traced StableHLO with a **symbolic batch
+dimension** plus float32 params, written into the versioned artifact layout.
+The exported module is lowered for both "cpu" and "tpu" so the same artifact
+serves on a dev laptop and a v5e pod, and takes uint8 images so normalization
+runs on device, fused into the first conv.
+
+CLI::
+
+    python -m kubernetes_deep_learning_tpu.export.exporter \
+        --model clothing-model --weights model.h5 --output ./models
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, get_spec
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+
+DEFAULT_PLATFORMS = ("cpu", "tpu")
+
+
+def trace_forward(
+    spec: ModelSpec,
+    variables: Any,
+    dtype: Any = jnp.bfloat16,
+    platforms: tuple[str, ...] = DEFAULT_PLATFORMS,
+) -> bytes:
+    """jax.export the forward fn with symbolic batch; return serialized bytes.
+
+    The exported module takes (variables, uint8 images[b,H,W,C]) so params
+    stay outside the module and can be hot-swapped per version.
+    """
+    from jax import export as jax_export
+
+    forward = build_forward(spec, dtype=dtype)
+    (b,) = jax_export.symbolic_shape("b")
+    img_spec = jax.ShapeDtypeStruct((b, *spec.input_shape), jnp.uint8)
+    var_specs = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), variables
+    )
+    exported = jax_export.export(jax.jit(forward), platforms=list(platforms))(
+        var_specs, img_spec
+    )
+    return exported.serialize()
+
+
+def export_model(
+    spec: ModelSpec,
+    variables: Any,
+    root: str,
+    version: int | None = None,
+    dtype: Any = jnp.bfloat16,
+    platforms: tuple[str, ...] = DEFAULT_PLATFORMS,
+) -> str:
+    """Export spec+variables into <root>/<name>/<version>/; returns the dir."""
+    if version is None:
+        latest = art.latest_version(root, spec.name)
+        version = 1 if latest is None else latest + 1
+    exported_bytes = trace_forward(spec, variables, dtype=dtype, platforms=platforms)
+    metadata = {
+        "jax_version": jax.__version__,
+        "platforms": list(platforms),
+        "compute_dtype": jnp.dtype(dtype).name,
+        "framework_version": __import__("kubernetes_deep_learning_tpu").__version__,
+    }
+    directory = art.version_dir(root, spec.name, version)
+    return art.save_artifact(directory, spec, variables, exported_bytes, metadata)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="Export a model for serving")
+    p.add_argument("--model", required=True, help="ModelSpec name (e.g. clothing-model)")
+    p.add_argument("--output", required=True, help="artifact root directory")
+    p.add_argument("--weights", default=None, help="Keras .h5 weights to import")
+    p.add_argument("--seed", type=int, default=None, help="random-init seed (no .h5)")
+    p.add_argument("--version", type=int, default=None, help="explicit version number")
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="jax platform override (e.g. cpu; export itself only traces)",
+    )
+    args = p.parse_args(argv)
+
+    from kubernetes_deep_learning_tpu.utils.platform import force_platform
+
+    force_platform(args.platform)
+
+    spec = get_spec(args.model)
+    if args.weights:
+        from kubernetes_deep_learning_tpu.models.keras_import import load_keras_h5
+
+        variables = load_keras_h5(spec, args.weights)
+        print(f"imported Keras weights from {args.weights}")
+    else:
+        seed = 0 if args.seed is None else args.seed
+        variables = init_variables(spec, seed=seed)
+        print(f"random-initialized weights (seed={seed})")
+
+    directory = export_model(
+        spec, variables, args.output, version=args.version, dtype=jnp.dtype(args.dtype)
+    )
+    print(f"exported {spec.name} -> {directory}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Artifact inspector: the ``saved_model_cli show`` equivalent.
+
+The reference's workflow requires running ``saved_model_cli show --dir ...``
+to discover signature/tensor names and then hand-copying them into the
+gateway (reference guide.md:199-236).  Here the inspector just renders what
+``spec.json`` and the StableHLO module already declare -- nothing needs to be
+hand-copied because every consumer reads the same ModelSpec.
+
+CLI::
+
+    python -m kubernetes_deep_learning_tpu.export.inspect --dir models/clothing-model/1
+    python -m kubernetes_deep_learning_tpu.export.inspect --root models  # list all
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+
+
+def describe(directory: str) -> str:
+    a = art.load_artifact(directory)
+    spec = a.spec
+    lines = [
+        f"Artifact: {directory}",
+        f"  model:         {spec.name} (family={spec.family})",
+        f"  description:   {spec.description}",
+        f"  input:         {spec.input_name} "
+        f"(-1, {', '.join(map(str, spec.input_shape))}) {spec.input_dtype}",
+        f"  output:        {spec.output_name} (-1, {spec.num_classes}) float32",
+        f"  preprocessing: {spec.preprocessing} (resize={spec.resize_filter})",
+        f"  labels:        {', '.join(spec.labels[:10])}"
+        + (" ..." if len(spec.labels) > 10 else ""),
+    ]
+    n_params = sum(int(np.prod(v.shape)) for v in _leaves(a.variables))
+    n_bytes = sum(v.nbytes for v in _leaves(a.variables))
+    lines.append(f"  params:        {n_params:,} ({n_bytes / 1e6:.1f} MB)")
+    if a.exported_bytes is not None:
+        exp = a.exported
+        lines.append(f"  stablehlo:     {len(a.exported_bytes):,} bytes, platforms={exp.platforms}")
+        lines.append(f"  calling conv:  v{exp.calling_convention_version}, batch dim symbolic")
+    for k, v in sorted(a.metadata.items()):
+        lines.append(f"  meta.{k}: {v}")
+    return "\n".join(lines)
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    else:
+        yield tree
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="Inspect exported model artifacts")
+    p.add_argument("--dir", help="one artifact version directory")
+    p.add_argument("--root", help="artifact root: list every model/version")
+    args = p.parse_args(argv)
+    if not args.dir and not args.root:
+        p.error("pass --dir or --root")
+    if args.dir:
+        print(describe(args.dir))
+    if args.root:
+        import os
+
+        for name in sorted(os.listdir(args.root)):
+            for v in art.scan_versions(args.root, name):
+                print(describe(art.version_dir(args.root, name, v)))
+                print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

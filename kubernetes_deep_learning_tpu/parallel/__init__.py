@@ -1,0 +1,19 @@
+from kubernetes_deep_learning_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    replicated,
+)
+from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+    ShardedEngine,
+    build_sharded_forward,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "ShardedEngine",
+    "build_sharded_forward",
+    "make_mesh",
+    "replicated",
+]

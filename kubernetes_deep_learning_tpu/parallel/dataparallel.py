@@ -1,0 +1,123 @@
+"""Sharded inference: data-parallel (+ optional tensor-parallel) predict.
+
+This is the first-class component the reference has no counterpart for
+(SURVEY.md section 2): scaling *within* the model tier across TPU chips over
+ICI, instead of only across k8s pod replicas over DCN.  Design follows the
+standard JAX recipe: pick a mesh, annotate shardings, let XLA insert the
+collectives.
+
+- images are sharded over the ``data`` axis (each chip runs the conv stack
+  on its batch shard; no cross-chip traffic in the backbone);
+- params are replicated, except -- when the mesh has a ``model`` axis > 1 --
+  wide Dense/pointwise kernels are sharded on their output dim, and XLA
+  inserts the all-gather/reduce where the annotation demands it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+from kubernetes_deep_learning_tpu.models import build_forward
+from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# Shard a param's last (output-features) dim over the model axis when it is
+# at least this wide and divisible; smaller layers are cheaper replicated.
+_TP_MIN_FEATURES = 512
+
+
+def param_partition_spec(path: tuple, arr, model_parallel: int) -> P:
+    """Partition rule: output-dim sharding for wide kernels, else replicate."""
+    if model_parallel <= 1:
+        return P()
+    last = arr.shape[-1] if getattr(arr, "ndim", 0) >= 2 else 0
+    is_kernel = path and getattr(path[-1], "key", "") == "kernel"
+    if is_kernel and last >= _TP_MIN_FEATURES and last % model_parallel == 0:
+        return P(*([None] * (arr.ndim - 1) + [MODEL_AXIS]))
+    return P()
+
+
+def shard_variables(variables: Any, mesh: Mesh) -> Any:
+    """device_put variables with the partition rules applied."""
+    model_parallel = mesh.shape[MODEL_AXIS]
+
+    def put(path, arr):
+        spec = param_partition_spec(path, arr, model_parallel)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(put, variables)
+
+
+def build_sharded_forward(spec: ModelSpec, mesh: Mesh, dtype: Any = jnp.bfloat16):
+    """jit the forward fn over the mesh: batch over data, params per rules.
+
+    Returns ``f(sharded_variables, images) -> logits`` where images may be a
+    host numpy array (it is device_put with batch sharding internally).
+    """
+    forward = build_forward(spec, dtype=dtype)
+    batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    out_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    jitted = jax.jit(forward, out_shardings=out_sharding)
+
+    def call(variables, images):
+        if isinstance(images, np.ndarray):
+            images = jax.device_put(images, batch_sharding)
+        return jitted(variables, images)
+
+    return call
+
+
+class ShardedEngine:
+    """Data-parallel serving engine over a device mesh.
+
+    Equivalent role to runtime.InferenceEngine but the batch is sharded over
+    every chip in the mesh; buckets are global batch sizes and must divide
+    evenly, so each bucket is rounded up to a multiple of the data-axis size.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        variables: Any,
+        mesh: Mesh,
+        buckets=(8, 16, 32, 64, 128, 256),
+        dtype: Any = jnp.bfloat16,
+    ):
+        self.spec = spec
+        self.mesh = mesh
+        self.n_data = mesh.shape[DATA_AXIS]
+        # Round each bucket UP to a multiple of the data-axis size so every
+        # chip gets an equal batch shard.
+        self.buckets = tuple(
+            sorted({-(-b // self.n_data) * self.n_data for b in buckets})
+        )
+        self.max_batch = self.buckets[-1]
+        self._variables = shard_variables(variables, mesh)
+        self._call = build_sharded_forward(spec, mesh, dtype=dtype)
+
+    def warmup(self) -> None:
+        for b in self.buckets:
+            x = np.zeros((b, *self.spec.input_shape), np.uint8)
+            np.asarray(self._call(self._variables, x))
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch {n} exceeds max bucket {self.max_batch}")
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        images = np.asarray(images)
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            pad = np.zeros((bucket - n, *self.spec.input_shape), images.dtype)
+            images = np.concatenate([images, pad], axis=0)
+        logits = self._call(self._variables, images)
+        return np.asarray(logits)[:n]

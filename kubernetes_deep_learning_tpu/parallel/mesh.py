@@ -1,0 +1,50 @@
+"""Device-mesh helpers: the ICI-collective layer of the framework.
+
+The reference's only distribution mechanism is DCN-class gRPC between pods
+plus k8s replica scaling (SURVEY.md section 2, "parallelism strategies");
+inside the model tier each pod owns one device.  On TPU the idiomatic
+equivalent of "more replicas" *inside* one host/slice is a
+``jax.sharding.Mesh`` whose collectives ride ICI -- this module is where
+that mesh is defined for both serving (data-parallel predict) and training.
+
+Axis convention:
+- ``data``  -- batch-sharded; serving and the train loop shard along this.
+- ``model`` -- reserved for tensor-parallel param sharding (wide head
+  layers); size 1 in pure data-parallel deployments.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    n_devices: int | None = None, model_parallel: int = 1, devices=None
+) -> Mesh:
+    """Build a (data, model) mesh over the local devices.
+
+    ``model_parallel`` devices are grouped on the innermost (fastest-ICI)
+    axis; the remainder shard the batch.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    grid = np.array(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))

@@ -1,0 +1,4 @@
+from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
+from kubernetes_deep_learning_tpu.runtime.batcher import BatcherClosed, DynamicBatcher, QueueFull
+
+__all__ = ["BatcherClosed", "DynamicBatcher", "InferenceEngine", "QueueFull"]

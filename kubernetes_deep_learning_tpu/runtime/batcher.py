@@ -1,0 +1,129 @@
+"""Dynamic batcher: aggregate concurrent single-image requests into batches.
+
+TF-Serving has server-side request batching in its C++ binary; the reference
+leaves it unconfigured (SURVEY.md component 7).  Here it is a first-class
+in-tree component, required to reach the >=4000 img/s/chip target: single
+images would waste the MXU, so concurrent requests are coalesced.
+
+Flush policy ("the hard part (a)", SURVEY.md section 7): a dispatch thread
+takes whatever is queued the moment it goes idle (continuous batching) but,
+when the batch is small, waits up to ``max_delay`` for more work to arrive.
+Under light load a request therefore pays at most max_delay extra latency;
+under heavy load the engine is never idle and batches grow to ``max_batch``
+naturally, with no timer on the hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+
+class BatcherClosed(RuntimeError):
+    """The batcher has been permanently shut down."""
+
+
+class QueueFull(RuntimeError):
+    """Transient overload: the request queue is at capacity (retryable)."""
+
+
+class DynamicBatcher:
+    def __init__(
+        self,
+        engine,
+        max_batch: int | None = None,
+        max_delay_ms: float = 2.0,
+        queue_cap: int = 2048,
+        registry: metrics_lib.Registry | None = None,
+    ):
+        self._engine = engine
+        self.max_batch = max_batch or engine.max_batch
+        self.max_delay = max_delay_ms / 1000.0
+        self.queue_cap = queue_cap
+        self._queue: list[tuple[np.ndarray, Future]] = []
+        self._cond = threading.Condition()
+        self._closed = False
+
+        registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
+        self._m_batch_size = registry.histogram(
+            "kdlt_batcher_batch_size",
+            "dispatched batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+        self._m_queue_full = registry.counter(
+            "kdlt_batcher_rejected_total", "requests rejected because queue was full"
+        )
+        self._thread = threading.Thread(target=self._run, name="kdlt-batcher", daemon=True)
+        self._thread.start()
+
+    def submit(self, image: np.ndarray) -> Future:
+        """Enqueue one HWC uint8 image; resolves to its logits row."""
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise BatcherClosed("batcher is shut down")
+            if len(self._queue) >= self.queue_cap:
+                self._m_queue_full.inc()
+                raise QueueFull("request queue full")
+            self._queue.append((np.asarray(image), fut))
+            self._cond.notify()
+        return fut
+
+    def predict(self, image: np.ndarray, timeout: float = 20.0) -> np.ndarray:
+        """Blocking single-image predict (the gateway's call).
+
+        Default timeout mirrors the reference's 20 s gRPC deadline
+        (reference model_server.py:55).
+        """
+        return self.submit(image).result(timeout=timeout)
+
+    def _take_batch(self) -> list[tuple[np.ndarray, Future]]:
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if self._closed and not self._queue:
+                return []
+            # Small batch and engine idle: linger briefly for stragglers.
+            if len(self._queue) < self.max_batch and self.max_delay > 0:
+                deadline = time.monotonic() + self.max_delay
+                while len(self._queue) < self.max_batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                        break
+            batch = self._queue[: self.max_batch]
+            del self._queue[: len(batch)]
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return  # closed and drained
+            images = np.stack([img for img, _ in batch])
+            self._m_batch_size.observe(len(batch))
+            try:
+                logits = self._engine.predict(images)
+            except Exception as e:  # propagate to all waiters, keep serving
+                for _, fut in batch:
+                    if not fut.cancelled():
+                        fut.set_exception(e)
+                continue
+            for i, (_, fut) in enumerate(batch):
+                if not fut.cancelled():
+                    fut.set_result(logits[i])
+
+    def close(self, drain: bool = True) -> None:
+        with self._cond:
+            self._closed = True
+            if not drain:
+                pending = self._queue[:]
+                self._queue.clear()
+                for _, fut in pending:
+                    fut.set_exception(BatcherClosed("batcher shut down"))
+            self._cond.notify_all()
+        self._thread.join(timeout=30.0)

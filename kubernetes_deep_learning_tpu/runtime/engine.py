@@ -1,0 +1,132 @@
+"""InferenceEngine: the in-tree replacement for TF-Serving's execution core.
+
+Where the reference delegates model execution to the external
+``tensorflow/serving:2.3.0`` C++ binary (reference tf-serving.dockerfile:1-5),
+this engine executes the exported StableHLO module (or the in-tree flax model)
+under jit on the local accelerator.
+
+TPU-first design decisions:
+
+- **Bucketed batch shapes.** Everything under jit compiles per concrete
+  shape; serving arbitrary batch sizes naively would recompile constantly.
+  Requests are padded up to a fixed bucket ladder (1, 2, 4, ..., max) and all
+  buckets are compiled at startup ("warmup"), so steady-state serving never
+  recompiles.  This is SURVEY.md section 7's hard part (b).
+- **Normalization on device.** The engine takes uint8 batches straight off
+  the wire; the scale/shift fuses into the first conv (see models.build_forward).
+- **Single dispatch thread semantics.** predict() is thread-safe; dispatch
+  is serialized by a lock since one accelerator executes one program at a
+  time anyway (the dynamic batcher is what creates large batches, not
+  concurrent dispatch).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Sequence
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.export.artifact import ModelArtifact
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        artifact: ModelArtifact,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        use_exported: bool = True,
+        device=None,
+        registry: metrics_lib.Registry | None = None,
+    ):
+        import jax
+
+        self.spec = artifact.spec
+        self.buckets = tuple(sorted(buckets))
+        self.max_batch = self.buckets[-1]
+        self._device = device or jax.devices()[0]
+        self._variables = jax.device_put(artifact.variables, self._device)
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+
+        if use_exported and artifact.exported_bytes is not None:
+            exported = artifact.exported
+            fn = exported.call
+        else:
+            from kubernetes_deep_learning_tpu.models import build_forward
+
+            fn = build_forward(self.spec)
+        self._jitted = jax.jit(fn)
+
+        registry = registry or metrics_lib.Registry()
+        self.registry = registry
+        self._m_infer_latency = registry.histogram(
+            "kdlt_engine_infer_seconds", "device execute latency per dispatch"
+        )
+        self._m_images = registry.counter("kdlt_engine_images_total", "images executed")
+        self._m_batches = registry.counter("kdlt_engine_batches_total", "batches executed")
+        self._m_pad_waste = registry.counter(
+            "kdlt_engine_pad_images_total", "padding rows executed (bucket waste)"
+        )
+        self._m_warmup = registry.gauge("kdlt_engine_warmup_seconds", "total warmup compile time")
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def warmup(self) -> float:
+        """Compile every bucket shape; gate readiness on completion.
+
+        The reference has no readiness probes, so a cold TF-Serving pod can
+        receive traffic before the model loads (SURVEY.md section 5); here
+        k8s readiness is wired to this warmup being done.
+        """
+        t0 = time.perf_counter()
+        for b in self.buckets:
+            x = np.zeros((b, *self.spec.input_shape), np.uint8)
+            np.asarray(self._jitted(self._variables, x))  # block until compiled+run
+        dt = time.perf_counter() - t0
+        self._m_warmup.set(dt)
+        self._ready.set()
+        return dt
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch {n} exceeds max bucket {self.max_batch}")
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """uint8 (N,H,W,C) -> float32 logits (N,num_classes); pads to bucket."""
+        images = np.asarray(images)
+        if images.ndim != 4 or images.shape[1:] != self.spec.input_shape:
+            raise ValueError(
+                f"expected (N, {self.spec.input_shape}), got {images.shape}"
+            )
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            pad = np.zeros((bucket - n, *self.spec.input_shape), images.dtype)
+            batch = np.concatenate([images, pad], axis=0)
+        else:
+            batch = images
+        t0 = time.perf_counter()
+        with self._lock:
+            logits = self._jitted(self._variables, batch)
+            out = np.asarray(logits)  # device sync
+        self._m_infer_latency.observe(time.perf_counter() - t0)
+        self._m_images.inc(n)
+        self._m_batches.inc()
+        self._m_pad_waste.inc(bucket - n)
+        return out[:n]
+
+    def predict_scores(self, images: np.ndarray) -> list[dict[str, float]]:
+        """Labelled score dicts, the reference's response shape
+        (reference model_server.py:46-49)."""
+        logits = self.predict(images)
+        labels = self.spec.labels
+        return [dict(zip(labels, map(float, row))) for row in logits]

@@ -1,0 +1,60 @@
+"""Client library + smoke-test CLI: the reference ``test.py`` equivalent.
+
+Reference behavior (reference test.py:1-16): POST a JSON body with an image
+URL to the gateway and print the score dict.  The CLI does exactly that; the
+library adds a direct model-server client for programmatic use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.serving import protocol
+
+# The reference's canonical test image (reference test.py:4).
+DEFAULT_IMAGE_URL = "http://bit.ly/mlbookcamp-pants"
+
+
+def predict_url(gateway_url: str, image_url: str, timeout: float = 30.0) -> dict:
+    """POST {"url": ...} to the gateway's /predict (reference test.py:15)."""
+    import requests
+
+    r = requests.post(f"{gateway_url}/predict", json={"url": image_url}, timeout=timeout)
+    r.raise_for_status()
+    return r.json()
+
+
+def predict_images(
+    server_url: str, model: str, images: np.ndarray, timeout: float = 30.0
+) -> tuple[np.ndarray, list[str]]:
+    """Send a uint8 image batch straight to the model server (no gateway)."""
+    import requests
+
+    r = requests.post(
+        f"{server_url}/v1/models/{model}:predict",
+        data=protocol.encode_predict_request(images),
+        headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+        timeout=timeout,
+    )
+    r.raise_for_status()
+    return protocol.decode_predict_response(
+        r.content, r.headers.get("Content-Type", "")
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="gateway smoke test (test.py equivalent)")
+    p.add_argument("--gateway", default="http://localhost:9696")
+    p.add_argument("--image-url", default=DEFAULT_IMAGE_URL)
+    args = p.parse_args(argv)
+    scores = predict_url(args.gateway, args.image_url)
+    print(json.dumps(scores, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""The serving gateway: the IO tier, same public API as the reference.
+
+Reference behavior being reproduced (reference model_server.py:52-66):
+``POST /predict`` with body ``{"url": "<image url>"}`` -> fetch the image,
+preprocess, call the model tier, return ``{label: score}`` for every class.
+The two-tier split and its rationale -- IO-bound gateway vs compute-bound
+model server, keep the accelerator from idling on IO -- is the reference's
+(guide.md:160-168) and is kept.
+
+Differences, all TPU-first:
+
+- preprocessing stops at resized **uint8**; normalization happens on the
+  TPU where it fuses into the first conv (the reference ships float32
+  TensorProtos, 3x the bytes);
+- the model contract (input size, resize filter, labels) is **discovered**
+  from the model server's /v1/models/<name> endpoint at startup instead of
+  hardcoded (reference model_server.py:18,21-32,40-47);
+- service discovery stays env-var based: ``KDLT_SERVING_HOST`` with a
+  localhost default, exactly like the reference's ``TF_SERVING_HOST``
+  (reference model_server.py:13, serving-gateway-deployment.yaml:22-24).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+from kubernetes_deep_learning_tpu.ops import preprocess
+from kubernetes_deep_learning_tpu.serving import protocol
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+DEFAULT_PORT = 9696          # reference gateway port (gateway.dockerfile:15-16)
+DEFAULT_SERVING_HOST = "localhost:8500"  # reference model_server.py:13
+SERVING_HOST_ENV = "KDLT_SERVING_HOST"
+MODEL_ENV = "KDLT_MODEL"
+DEFAULT_MODEL = "clothing-model"
+PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
+
+
+class Gateway:
+    def __init__(
+        self,
+        serving_host: str | None = None,
+        model: str | None = None,
+        port: int = DEFAULT_PORT,
+        host: str = "0.0.0.0",
+    ):
+        self.serving_host = serving_host or os.environ.get(
+            SERVING_HOST_ENV, DEFAULT_SERVING_HOST
+        )
+        self.model = model or os.environ.get(MODEL_ENV, DEFAULT_MODEL)
+        self._base = f"http://{self.serving_host}"
+        self._local = threading.local()
+        self._spec: ModelSpec | None = None
+        self._spec_lock = threading.Lock()
+
+        self.registry = metrics_lib.Registry()
+        self._m_requests = self.registry.counter("kdlt_gateway_requests_total", "requests")
+        self._m_errors = self.registry.counter("kdlt_gateway_errors_total", "errors")
+        self._m_latency = self.registry.histogram(
+            "kdlt_gateway_request_seconds", "end-to-end request latency"
+        )
+        self._m_fetch = self.registry.histogram(
+            "kdlt_gateway_fetch_seconds", "image download+decode+resize latency"
+        )
+
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # --- model-server client ----------------------------------------------
+
+    def _session(self):
+        import requests
+
+        if not hasattr(self._local, "session"):
+            self._local.session = requests.Session()
+        return self._local.session
+
+    @property
+    def spec(self) -> ModelSpec:
+        """The served model's contract, discovered from the model tier."""
+        if self._spec is None:
+            with self._spec_lock:
+                if self._spec is None:
+                    r = self._session().get(
+                        f"{self._base}/v1/models/{self.model}", timeout=10
+                    )
+                    r.raise_for_status()
+                    self._spec = ModelSpec.from_json(r.text)
+        return self._spec
+
+    def apply_model(self, url: str) -> dict[str, float]:
+        """url -> {label: score}; the reference's apply_model
+        (reference model_server.py:52-56)."""
+        spec = self.spec
+        t0 = time.perf_counter()
+        data = preprocess.fetch_image_bytes(url)
+        image = preprocess.preprocess_bytes(
+            data, spec.input_shape[:2], filter=spec.resize_filter
+        )
+        self._m_fetch.observe(time.perf_counter() - t0)
+
+        body = protocol.encode_predict_request(image[None])
+        r = self._session().post(
+            f"{self._base}/v1/models/{self.model}:predict",
+            data=body,
+            headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+            timeout=PREDICT_TIMEOUT_S,
+        )
+        if r.status_code != 200:
+            raise RuntimeError(f"model server error {r.status_code}: {r.text[:200]}")
+        logits, labels = protocol.decode_predict_response(
+            r.content, r.headers.get("Content-Type", "")
+        )
+        return dict(zip(labels, map(float, logits[0])))
+
+    # --- HTTP plumbing ----------------------------------------------------
+
+    def _make_handler(self):
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, b"ok", "text/plain")
+                if self.path == "/readyz":
+                    try:
+                        gw.spec  # reachable + spec discoverable => ready
+                        return self._send(200, b"ready", "text/plain")
+                    except Exception as e:
+                        return self._send(503, str(e).encode(), "text/plain")
+                if self.path == "/metrics":
+                    return self._send(200, gw.registry.render().encode(), "text/plain")
+                self._send(404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    return self._send(404, b'{"error": "not found"}')
+                t0 = time.perf_counter()
+                gw._m_requests.inc()
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    url = req["url"]
+                    scores = gw.apply_model(url)
+                    self._send(200, json.dumps(scores).encode())
+                except Exception as e:
+                    gw._m_errors.inc()
+                    self._send(400, json.dumps({"error": str(e)}).encode())
+                finally:
+                    gw._m_latency.observe(time.perf_counter() - t0)
+
+        return Handler
+
+    def start(self, block: bool = False) -> None:
+        if block:
+            self._httpd.serve_forever()
+        else:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="kdlt-gateway", daemon=True
+            )
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="serving gateway")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--serving-host", default=None, help=f"overrides ${SERVING_HOST_ENV}")
+    p.add_argument("--model", default=None, help=f"overrides ${MODEL_ENV}")
+    args = p.parse_args(argv)
+    gw = Gateway(serving_host=args.serving_host, model=args.model, port=args.port)
+    print(f"gateway listening on :{gw.port}, model tier at {gw.serving_host}")
+    gw.start(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,267 @@
+"""The TPU model server: in-tree replacement for TF-Serving.
+
+The reference's model tier is the external ``tensorflow/serving:2.3.0`` C++
+binary: versioned model loading from /models/<name>/<n>, a PredictionService
+on :8500, batched graph execution (reference tf-serving.dockerfile:1-5,
+SURVEY.md component 7).  This server reproduces those capabilities in-tree:
+
+- scans an artifact root for every model's highest version (same layout rule),
+- executes on the local accelerator through InferenceEngine (XLA:TPU is the
+  "native layer" here -- the compiled StableHLO program is what C++ was to
+  TF-Serving),
+- server-side dynamic batching (TF-Serving has it; the reference never
+  configured it),
+- /healthz liveness, /readyz readiness gated on warm compiles, /metrics.
+
+Endpoints::
+
+    POST /v1/models/<name>:predict     msgpack or JSON predict
+    GET  /v1/models                    list served models
+    GET  /v1/models/<name>             the ModelSpec (the discoverable
+                                       contract; replaces saved_model_cli)
+    GET  /healthz | /readyz | /metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.runtime import DynamicBatcher, InferenceEngine, QueueFull
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+_PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+_MODEL_RE = re.compile(r"^/v1/models/([^/:]+)$")
+
+DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-model-service.yaml:9-10)
+
+
+class ServedModel:
+    def __init__(self, artifact, buckets, max_delay_ms, registry, use_batcher=True):
+        self.artifact = artifact
+        # Each model gets a labeled child registry so two models' engines
+        # never emit colliding metric series on the shared /metrics page.
+        model_registry = registry.with_labels(model=artifact.spec.name)
+        self.engine = InferenceEngine(artifact, buckets=buckets, registry=model_registry)
+        self.batcher = (
+            DynamicBatcher(self.engine, max_delay_ms=max_delay_ms, registry=model_registry)
+            if use_batcher
+            else None
+        )
+        self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        # Multi-image requests go straight to the engine (they are already a
+        # batch); single images go through the batcher to coalesce across
+        # concurrent requests.
+        if self.batcher is not None and images.shape[0] == 1:
+            return self.batcher.predict(images[0])[None]
+        return self.engine.predict(images)
+
+
+class ModelServer:
+    def __init__(
+        self,
+        model_root: str,
+        port: int = DEFAULT_PORT,
+        buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+        max_delay_ms: float = 2.0,
+        use_batcher: bool = True,
+        host: str = "0.0.0.0",
+    ):
+        self.registry = metrics_lib.Registry()
+        self._m_requests = self.registry.counter(
+            "kdlt_server_requests_total", "predict requests"
+        )
+        self._m_errors = self.registry.counter(
+            "kdlt_server_errors_total", "failed predict requests"
+        )
+        self._m_latency = self.registry.histogram(
+            "kdlt_server_request_seconds", "request handling latency"
+        )
+        self.models: dict[str, ServedModel] = {}
+        self.model_root = model_root
+        self._load_all(buckets, max_delay_ms, use_batcher)
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def _load_all(self, buckets, max_delay_ms, use_batcher) -> None:
+        import os
+
+        names = sorted(os.listdir(self.model_root)) if os.path.isdir(self.model_root) else []
+        for name in names:
+            version = art.latest_version(self.model_root, name)
+            if version is None:
+                continue
+            directory = art.version_dir(self.model_root, name, version)
+            artifact = art.load_artifact(directory)
+            self.models[artifact.spec.name] = ServedModel(
+                artifact, buckets, max_delay_ms, self.registry, use_batcher
+            )
+            print(f"loaded {artifact.spec.name} v{version} from {directory}")
+        if not self.models:
+            raise FileNotFoundError(f"no model artifacts under {self.model_root!r}")
+
+    def warmup(self) -> None:
+        for m in self.models.values():
+            dt = m.engine.warmup()
+            print(f"warmed {m.artifact.spec.name}: {dt:.1f}s")
+
+    @property
+    def ready(self) -> bool:
+        return all(m.engine.ready for m in self.models.values())
+
+    # --- HTTP plumbing -----------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet; metrics cover it
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, obj):
+                self._send(code, json.dumps(obj).encode())
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(200, b"ok", "text/plain")
+                if self.path == "/readyz":
+                    if server.ready:
+                        return self._send(200, b"ready", "text/plain")
+                    return self._send(503, b"warming up", "text/plain")
+                if self.path == "/metrics":
+                    return self._send(200, server.registry.render().encode(), "text/plain")
+                if self.path == "/v1/models":
+                    return self._send_json(
+                        200,
+                        {
+                            name: {"version": m.version, "ready": m.engine.ready}
+                            for name, m in server.models.items()
+                        },
+                    )
+                m = _MODEL_RE.match(self.path)
+                if m:
+                    model = server.models.get(m.group(1))
+                    if model is None:
+                        return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
+                    return self._send(
+                        200, model.artifact.spec.to_json().encode(), "application/json"
+                    )
+                self._send_json(404, {"error": "not found"})
+
+            def do_POST(self):
+                from kubernetes_deep_learning_tpu.serving import protocol
+
+                t0 = time.perf_counter()
+                server._m_requests.inc()
+                m = _PREDICT_RE.match(self.path)
+                if not m:
+                    server._m_errors.inc()
+                    return self._send_json(404, {"error": "not found"})
+                model = server.models.get(m.group(1))
+                if model is None:
+                    server._m_errors.inc()
+                    return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
+                    images = protocol.decode_predict_request(body, ctype)
+                    spec = model.artifact.spec
+                    if images.ndim == 3:
+                        images = images[None]
+                    if images.shape[1:] != spec.input_shape:
+                        raise ValueError(
+                            f"input shape {images.shape[1:]} != {spec.input_shape}"
+                        )
+                    logits = model.predict(images)
+                    out, out_ctype = protocol.encode_predict_response(
+                        logits, spec.labels, ctype
+                    )
+                    self._send(200, out, out_ctype)
+                except ValueError as e:  # malformed request
+                    server._m_errors.inc()
+                    self._send_json(400, {"error": str(e)})
+                except (QueueFull, FuturesTimeout) as e:  # transient overload
+                    server._m_errors.inc()
+                    self._send_json(503, {"error": f"overloaded: {e or 'timed out'}"})
+                except Exception as e:  # internal failure
+                    server._m_errors.inc()
+                    self._send_json(500, {"error": str(e)})
+                finally:
+                    server._m_latency.observe(time.perf_counter() - t0)
+
+        return Handler
+
+    def start(self, block: bool = False) -> None:
+        if block:
+            self._httpd.serve_forever()
+        else:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name="kdlt-model-server", daemon=True
+            )
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        for m in self.models.values():
+            if m.batcher is not None:
+                m.batcher.close(drain=False)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="TPU model server")
+    p.add_argument("--models", required=True, help="artifact root (/models)")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    p.add_argument("--buckets", default="1,2,4,8,16,32,64,128")
+    p.add_argument("--max-delay-ms", type=float, default=2.0)
+    p.add_argument("--no-batching", action="store_true")
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="jax platform override (e.g. cpu for dev); default $KDLT_PLATFORM",
+    )
+    args = p.parse_args(argv)
+
+    from kubernetes_deep_learning_tpu.utils.platform import force_platform
+
+    force_platform(args.platform)
+
+    server = ModelServer(
+        args.models,
+        port=args.port,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_delay_ms=args.max_delay_ms,
+        use_batcher=not args.no_batching,
+    )
+    server.warmup()
+    print(f"model server listening on :{server.port}")
+    server.start(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Gateway <-> model-server wire protocol.
+
+The reference marshals numpy -> TensorProto -> gRPC PredictRequest
+(reference model_server.py:35-43) and unmarshals ``float_val`` lists back
+(reference model_server.py:46-49).  Here the wire is msgpack over HTTP with
+**raw little-endian tensor bytes**, for two TPU-first reasons:
+
+- images travel as uint8 (3x smaller than the reference's float32
+  TensorProto; normalization happens on-device at the server), and
+- zero-copy decode: np.frombuffer over the msgpack bin payload, no per-float
+  protobuf parsing.
+
+A JSON fallback (``{"instances": [...]}``, TF-Serving REST style) is kept for
+debuggability with curl.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import msgpack
+import numpy as np
+
+MSGPACK_CONTENT_TYPE = "application/x-msgpack"
+JSON_CONTENT_TYPE = "application/json"
+
+
+def encode_tensor(arr: np.ndarray) -> dict[str, Any]:
+    arr = np.ascontiguousarray(arr)
+    return {
+        "shape": list(arr.shape),
+        "dtype": arr.dtype.name,
+        "data": arr.tobytes(),
+    }
+
+
+def decode_tensor(d: dict[str, Any]) -> np.ndarray:
+    arr = np.frombuffer(d["data"], dtype=np.dtype(d["dtype"]))
+    return arr.reshape(d["shape"])
+
+
+def encode_predict_request(images: np.ndarray) -> bytes:
+    """uint8 (N,H,W,C) batch -> msgpack request body."""
+    return msgpack.packb({"inputs": encode_tensor(images)})
+
+
+def decode_predict_request(body: bytes, content_type: str) -> np.ndarray:
+    if content_type.startswith(MSGPACK_CONTENT_TYPE):
+        msg = msgpack.unpackb(body)
+        return decode_tensor(msg["inputs"])
+    if content_type.startswith(JSON_CONTENT_TYPE) or not content_type:
+        msg = json.loads(body)
+        arr = np.asarray(msg["instances"])
+        if arr.dtype.kind in "iu":
+            if arr.size and (arr.min() < 0 or arr.max() > 255):
+                raise ValueError(
+                    "integer pixel values must be in [0, 255]; send floats "
+                    "for pre-normalized data"
+                )
+            arr = arr.astype(np.uint8)
+        elif arr.dtype != np.float32:
+            arr = arr.astype(np.float32)
+        return arr
+    raise ValueError(f"unsupported content type {content_type!r}")
+
+
+def encode_predict_response(
+    logits: np.ndarray, labels: tuple[str, ...], content_type: str
+) -> tuple[bytes, str]:
+    if content_type.startswith(MSGPACK_CONTENT_TYPE):
+        body = msgpack.packb(
+            {"outputs": encode_tensor(logits), "labels": list(labels)}
+        )
+        return body, MSGPACK_CONTENT_TYPE
+    scores = [dict(zip(labels, map(float, row))) for row in logits]
+    return json.dumps({"predictions": scores}).encode(), JSON_CONTENT_TYPE
+
+
+def decode_predict_response(body: bytes, content_type: str) -> tuple[np.ndarray, list[str]]:
+    if content_type.startswith(MSGPACK_CONTENT_TYPE):
+        msg = msgpack.unpackb(body)
+        return decode_tensor(msg["outputs"]), list(msg["labels"])
+    msg = json.loads(body)
+    preds = msg["predictions"]
+    labels = list(preds[0].keys())
+    return np.asarray([[p[l] for l in labels] for p in preds], np.float32), labels

@@ -1,0 +1,7 @@
+from kubernetes_deep_learning_tpu.training.trainer import (
+    TrainState,
+    build_train_step,
+    create_train_state,
+)
+
+__all__ = ["TrainState", "build_train_step", "create_train_state"]

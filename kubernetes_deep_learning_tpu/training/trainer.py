@@ -1,0 +1,113 @@
+"""Fine-tuning: sharded training step for the model zoo.
+
+The reference has no training loop at all (SURVEY.md: "It is not a training
+framework"); its artifact comes from an out-of-band transfer-learning run
+(reference guide.md:176).  This module supplies that missing capability
+in-tree -- the loop that *produces* a servable artifact -- designed the JAX
+way: a pure ``train_step`` jitted over a (data, model) mesh, batch sharded on
+``data``, params replicated or tensor-parallel per parallel.dataparallel's
+partition rules, with XLA inserting the gradient all-reduce implied by the
+sharding annotations (no hand-written collectives, no NCCL analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+from kubernetes_deep_learning_tpu.models import create_model
+from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+from kubernetes_deep_learning_tpu.parallel.dataparallel import shard_variables
+from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: Any
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+    def variables(self):
+        return {"params": self.params, "batch_stats": self.batch_stats}
+
+
+jax.tree_util.register_dataclass(
+    TrainState, data_fields=["step", "params", "batch_stats", "opt_state"], meta_fields=[]
+)
+
+
+def create_train_state(
+    spec: ModelSpec,
+    tx: optax.GradientTransformation,
+    seed: int = 0,
+    variables: Any | None = None,
+    mesh: Mesh | None = None,
+) -> TrainState:
+    """Init (or adopt) variables and optimizer state; shard if mesh given."""
+    model = create_model(spec)
+    if variables is None:
+        dummy = jnp.zeros((1, *spec.input_shape), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(seed), dummy)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    if mesh is not None:
+        sharded = shard_variables(
+            {"params": params, "batch_stats": batch_stats}, mesh
+        )
+        params, batch_stats = sharded["params"], sharded["batch_stats"]
+    opt_state = tx.init(params)
+    return TrainState(jnp.zeros((), jnp.int32), params, batch_stats, opt_state)
+
+
+def build_train_step(
+    spec: ModelSpec,
+    tx: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    dtype: Any = None,
+) -> Callable:
+    """Return jitted ``train_step(state, images_u8, labels) -> (state, metrics)``.
+
+    Images are raw uint8 batches; normalization happens inside the step so
+    the input pipeline stays dtype-thin (same choice as serving).  With a
+    mesh, the batch arrives sharded over ``data`` and the gradient
+    all-reduce is implied by params' (replicated / model-sharded) shardings.
+    """
+    model = create_model(spec, dtype=dtype)
+
+    def loss_fn(params, batch_stats, images, labels):
+        x = normalize(images, spec.preprocessing)
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, (updates["batch_stats"], acc)
+
+    def train_step(state: TrainState, images, labels):
+        (loss, (new_stats, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, images, labels
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(state.step + 1, new_params, new_stats, new_opt_state)
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    if mesh is None:
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        train_step,
+        in_shardings=(None, batch_sharding, batch_sharding),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,162 @@
+"""Minimal thread-safe metrics: counters + histograms + Prometheus text.
+
+The reference has no observability at all (SURVEY.md section 5: no /metrics,
+no structured logs); both tiers here expose a /metrics endpoint rendered from
+one of these registries, which also feeds bench.py's latency percentiles.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+# Default latency buckets in seconds (sub-ms to 20 s, the reference's
+# implicit deadline ceiling, reference model_server.py:55).
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.015, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str] | None, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in (labels or {}).items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    def __init__(self, name: str, help: str = "", labels: dict[str, str] | None = None):
+        self.name, self.help, self.labels = name, help, labels
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name}{_fmt_labels(self.labels)} {self._value}\n"
+        )
+
+
+class Gauge(Counter):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name}{_fmt_labels(self.labels)} {self._value}\n"
+        )
+
+
+class Histogram:
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets=DEFAULT_BUCKETS,
+        labels: dict[str, str] | None = None,
+    ):
+        self.name, self.help, self.labels = name, help, labels
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket upper bounds (q in [0,1])."""
+        with self._lock:
+            n = self._n
+            if n == 0:
+                return 0.0
+            target = q * n
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= target:
+                    return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def render(self) -> str:
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cum = 0
+        with self._lock:
+            for le, c in zip(self.buckets, self._counts):
+                cum += c
+                out.append(f'{self.name}_bucket{_fmt_labels(self.labels, f'le="{le}"')} {cum}')
+            cum += self._counts[-1]
+            out.append(f'{self.name}_bucket{_fmt_labels(self.labels, 'le="+Inf"')} {cum}')
+            out.append(f"{self.name}_sum{_fmt_labels(self.labels)} {self._sum}")
+            out.append(f"{self.name}_count{_fmt_labels(self.labels)} {self._n}")
+        return "\n".join(out) + "\n"
+
+
+class Registry:
+    def __init__(self, labels: dict[str, str] | None = None):
+        """``labels`` are applied to every metric created through this
+        registry (e.g. Registry(labels={"model": name}) per served model, so
+        two models' engines never emit colliding series)."""
+        self._metrics: list = []
+        self._labels = dict(labels or {})
+        self._keys: set = set()
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._add(Counter(name, help, labels=self._labels or None))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._add(Gauge(name, help, labels=self._labels or None))
+
+    def histogram(self, name: str, help: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._add(Histogram(name, help, buckets, labels=self._labels or None))
+
+    def with_labels(self, **labels: str) -> "Registry":
+        """Child registry sharing this one's output but adding labels."""
+        child = Registry({**self._labels, **labels})
+        self._add(child)
+        return child
+
+    def _add(self, m):
+        with self._lock:
+            name = getattr(m, "name", None)
+            if name is not None:
+                key = (name, tuple(sorted((m.labels or {}).items())))
+                if key in self._keys:
+                    raise ValueError(f"duplicate metric {name!r} with same labels")
+                self._keys.add(key)
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        with self._lock:
+            return "".join(m.render() for m in self._metrics)

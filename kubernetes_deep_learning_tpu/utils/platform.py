@@ -1,0 +1,40 @@
+"""Select the JAX platform explicitly (dev-on-CPU vs serve-on-TPU).
+
+SURVEY.md section 4 calls for a CPU backend so the serving path is testable
+without TPUs.  Selecting it via ``JAX_PLATFORMS`` alone is not reliable in
+environments whose sitecustomize pre-imports jax with a TPU plugin already
+latched, so this helper rewrites the live config and, when leaving a
+non-standard plugin platform, drops its backend factory so nothing dials
+accelerator hardware from a CPU-only process.
+"""
+
+from __future__ import annotations
+
+import os
+
+PLATFORM_ENV = "KDLT_PLATFORM"
+
+
+def force_platform(name: str | None) -> None:
+    """name: "cpu", "tpu", ... or None => honor $KDLT_PLATFORM, else default."""
+    if name is None:
+        name = os.environ.get(PLATFORM_ENV)
+    if not name:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", name)
+    if name == "cpu":
+        try:
+            import jax._src.xla_bridge as xb
+
+            # Drop only foreign PJRT plugins (e.g. the host's "axon" TPU
+            # tunnel): they dial hardware at backend init.  Builtin platform
+            # factories must stay registered -- removing "tpu" breaks MLIR
+            # lowering-rule registration in libraries imported later.
+            builtin = {"cpu", "tpu", "cuda", "rocm", "gpu", "metal", "METAL"}
+            for plugin in list(xb._backend_factories):
+                if plugin not in builtin:
+                    xb._backend_factories.pop(plugin, None)
+        except Exception:
+            pass  # jax internals moved; config update above still applies

@@ -14,6 +14,15 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The host environment force-registers a real-TPU PJRT plugin ("axon") into
+# every interpreter via sitecustomize, which imports jax at interpreter
+# startup with JAX_PLATFORMS=axon -- so the env vars above are latched too
+# late and tests would silently run on (and wedge) the single-client TPU
+# tunnel.  Make tests hermetic CPU-only before the first backend lookup.
+from kubernetes_deep_learning_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform("cpu")
+
 import pytest  # noqa: E402
 
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec  # noqa: E402

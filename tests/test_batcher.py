@@ -1,0 +1,110 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.runtime.batcher import (
+    BatcherClosed,
+    DynamicBatcher,
+    QueueFull,
+)
+
+
+class FakeEngine:
+    """Deterministic stand-in: logit = [sum(image), batch_index_invariant]."""
+
+    max_batch = 8
+
+    def __init__(self, delay_s=0.0, fail=False):
+        self.delay_s = delay_s
+        self.fail = fail
+        self.batch_sizes = []
+        self._lock = threading.Lock()
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        with self._lock:
+            self.batch_sizes.append(images.shape[0])
+        if self.fail:
+            raise RuntimeError("boom")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        sums = images.reshape(images.shape[0], -1).sum(axis=1).astype(np.float32)
+        return np.stack([sums, sums * 2], axis=1)
+
+
+def _img(value: int) -> np.ndarray:
+    return np.full((2, 2, 3), value, np.uint8)
+
+
+def test_single_request_roundtrip():
+    b = DynamicBatcher(FakeEngine(), max_delay_ms=1)
+    try:
+        out = b.predict(_img(3))
+        assert out.tolist() == [36.0, 72.0]
+    finally:
+        b.close()
+
+
+def test_concurrent_requests_batch_and_map_correctly():
+    eng = FakeEngine(delay_s=0.02)
+    b = DynamicBatcher(eng, max_delay_ms=5)
+    results: dict[int, np.ndarray] = {}
+    errors = []
+
+    def worker(v):
+        try:
+            results[v] = b.predict(_img(v))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(v,)) for v in range(40)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for v in range(40):
+            assert results[v].tolist() == [v * 12.0, v * 24.0], v
+        # while the engine sleeps, the queue must coalesce into real batches
+        assert max(eng.batch_sizes) > 1
+        assert all(s <= eng.max_batch for s in eng.batch_sizes)
+    finally:
+        b.close()
+
+
+def test_engine_error_propagates_and_batcher_survives():
+    eng = FakeEngine(fail=True)
+    b = DynamicBatcher(eng, max_delay_ms=1)
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            b.predict(_img(1))
+        eng.fail = False
+        assert b.predict(_img(2)).tolist() == [24.0, 48.0]
+    finally:
+        b.close()
+
+
+def test_queue_cap_rejects():
+    eng = FakeEngine(delay_s=0.2)
+    b = DynamicBatcher(eng, max_delay_ms=0, queue_cap=2)
+    try:
+        b.submit(_img(0))  # dispatcher takes this
+        time.sleep(0.05)   # let dispatch start, engine now busy 200ms
+        b.submit(_img(1))
+        b.submit(_img(2))
+        with pytest.raises(QueueFull):
+            for _ in range(3):
+                b.submit(_img(3))
+    finally:
+        b.close()
+
+
+def test_close_rejects_new_and_drains():
+    b = DynamicBatcher(FakeEngine(), max_delay_ms=1)
+    fut = b.submit(_img(1))
+    b.close()
+    assert fut.result(timeout=5).tolist() == [12.0, 24.0]
+    with pytest.raises(BatcherClosed):
+        b.submit(_img(1))

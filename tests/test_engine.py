@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import export_model, load_artifact
+from kubernetes_deep_learning_tpu.export.artifact import version_dir
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+from kubernetes_deep_learning_tpu.runtime import InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+
+    spec = register_spec(
+        ModelSpec(
+            name="engine-xception",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("a", "b", "c", "d"),
+            preprocessing="tf",
+        )
+    )
+    root = tmp_path_factory.mktemp("models")
+    variables = init_variables(spec, seed=1)
+    export_model(spec, variables, str(root), dtype=np.float32)
+    artifact = load_artifact(version_dir(str(root), spec.name, 1))
+    eng = InferenceEngine(artifact, buckets=(1, 2, 4, 8))
+    return eng, variables, spec
+
+
+def test_warmup_sets_ready(engine):
+    eng, _, _ = engine
+    assert not eng.ready or True  # warmup may already have run in other tests
+    dt = eng.warmup()
+    assert eng.ready and dt >= 0
+
+
+def test_padding_does_not_change_results(engine):
+    import jax
+
+    eng, variables, spec = engine
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(3, 96, 96, 3), dtype=np.uint8)  # pads to 4
+    got = eng.predict(x)
+    assert got.shape == (3, 4)
+    fwd = jax.jit(build_forward(spec, dtype=None))
+    want = np.asarray(fwd(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_bucket_selection(engine):
+    eng, _, _ = engine
+    assert eng.bucket_for(1) == 1
+    assert eng.bucket_for(3) == 4
+    assert eng.bucket_for(8) == 8
+    with pytest.raises(ValueError):
+        eng.bucket_for(9)
+
+
+def test_input_validation(engine):
+    eng, _, _ = engine
+    with pytest.raises(ValueError, match="expected"):
+        eng.predict(np.zeros((1, 10, 10, 3), np.uint8))
+
+
+def test_predict_scores_labels(engine):
+    eng, _, spec = engine
+    out = eng.predict_scores(np.zeros((2, 96, 96, 3), np.uint8))
+    assert len(out) == 2
+    assert set(out[0]) == set(spec.labels)
+
+
+def test_metrics_populated(engine):
+    eng, _, _ = engine
+    eng.predict(np.zeros((1, 96, 96, 3), np.uint8))
+    text = eng.registry.render()
+    assert "kdlt_engine_images_total" in text
+    assert "kdlt_engine_infer_seconds" in text

@@ -1,0 +1,90 @@
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import (
+    export_model,
+    latest_version,
+    load_artifact,
+    scan_versions,
+)
+from kubernetes_deep_learning_tpu.export.artifact import version_dir
+from kubernetes_deep_learning_tpu.export.inspect import describe
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+
+
+@pytest.fixture(scope="module")
+def exported_dir(tmp_path_factory, tiny_spec_module):
+    root = tmp_path_factory.mktemp("models")
+    variables = init_variables(tiny_spec_module, seed=3)
+    export_model(tiny_spec_module, variables, str(root), dtype=np.float32)
+    return str(root), variables
+
+
+@pytest.fixture(scope="module")
+def tiny_spec_module():
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+
+    return register_spec(
+        ModelSpec(
+            name="export-xception",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("a", "b", "c", "d"),
+            preprocessing="tf",
+        )
+    )
+
+
+def test_versioned_layout_and_scan(exported_dir, tiny_spec_module):
+    root, variables = exported_dir
+    assert scan_versions(root, tiny_spec_module.name) == [1]
+    export_model(tiny_spec_module, variables, root, dtype=np.float32)
+    assert scan_versions(root, tiny_spec_module.name) == [1, 2]
+    assert latest_version(root, tiny_spec_module.name) == 2
+
+
+def test_artifact_roundtrip_and_stablehlo_call(exported_dir, tiny_spec_module):
+    import jax
+
+    root, variables = exported_dir
+    a = load_artifact(version_dir(root, tiny_spec_module.name, 1))
+    assert a.spec == tiny_spec_module
+    assert a.exported_bytes and a.metadata["platforms"] == ["cpu", "tpu"]
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(3, 96, 96, 3), dtype=np.uint8)
+    got = np.asarray(a.exported.call(a.variables, x))
+
+    fwd = jax.jit(build_forward(tiny_spec_module, dtype=None))
+    want = np.asarray(fwd(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_symbolic_batch_dim(exported_dir, tiny_spec_module):
+    root, _ = exported_dir
+    a = load_artifact(version_dir(root, tiny_spec_module.name, 1))
+    for n in (1, 2, 5):
+        x = np.zeros((n, 96, 96, 3), np.uint8)
+        out = np.asarray(a.exported.call(a.variables, x))
+        assert out.shape == (n, 4)
+
+
+def test_inspector_describe(exported_dir, tiny_spec_module):
+    root, _ = exported_dir
+    text = describe(version_dir(root, tiny_spec_module.name, 1))
+    assert "export-xception" in text
+    assert "stablehlo" in text
+    assert "(-1, 96, 96, 3)" in text
+    assert "params:" in text
+
+
+def test_exporter_cli(tmp_path):
+    from kubernetes_deep_learning_tpu.export.exporter import main as export_main
+    from kubernetes_deep_learning_tpu.export.inspect import main as inspect_main
+
+    rc = export_main(
+        ["--model", "export-xception", "--output", str(tmp_path), "--dtype", "float32"]
+    )
+    assert rc == 0
+    assert scan_versions(str(tmp_path), "export-xception") == [1]
+    assert inspect_main(["--root", str(tmp_path)]) == 0

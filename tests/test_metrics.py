@@ -1,0 +1,53 @@
+import pytest
+
+from kubernetes_deep_learning_tpu.utils.metrics import Histogram, Registry
+
+
+def test_counter_gauge_histogram_render():
+    r = Registry()
+    c = r.counter("c_total", "a counter")
+    g = r.gauge("g", "a gauge")
+    h = r.histogram("h_seconds", "a histogram", buckets=(0.1, 1.0))
+    c.inc()
+    c.inc(2)
+    g.set(5)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(10)
+    text = r.render()
+    assert "c_total 3.0" in text
+    assert "g 5" in text
+    assert 'h_seconds_bucket{le="0.1"} 1' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+
+
+def test_histogram_percentile():
+    h = Histogram("x", buckets=(0.01, 0.1, 1.0))
+    for _ in range(99):
+        h.observe(0.05)
+    h.observe(5.0)
+    assert h.percentile(0.5) == 0.1
+    assert h.percentile(0.99) == 0.1
+    assert h.percentile(1.0) == float("inf")
+
+
+def test_duplicate_metric_rejected():
+    r = Registry()
+    r.counter("dup_total")
+    with pytest.raises(ValueError, match="duplicate"):
+        r.counter("dup_total")
+
+
+def test_labeled_child_registries_do_not_collide():
+    r = Registry()
+    a = r.with_labels(model="a")
+    b = r.with_labels(model="b")
+    a.counter("kdlt_engine_images_total").inc(1)
+    b.counter("kdlt_engine_images_total").inc(2)
+    text = r.render()
+    assert 'kdlt_engine_images_total{model="a"} 1.0' in text
+    assert 'kdlt_engine_images_total{model="b"} 2.0' in text
+    # labels flow into histogram series too
+    a.histogram("lat_seconds", buckets=(1.0,)).observe(0.5)
+    assert 'lat_seconds_bucket{model="a",le="1.0"} 1' in r.render()

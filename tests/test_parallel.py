@@ -1,0 +1,82 @@
+"""Multi-chip tests on the virtual 8-device CPU mesh (see conftest)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+from kubernetes_deep_learning_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    ShardedEngine,
+    make_mesh,
+)
+from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+    build_sharded_forward,
+    shard_variables,
+)
+
+
+def test_eight_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(8)
+    assert mesh.shape == {DATA_AXIS: 8, MODEL_AXIS: 1}
+    mesh = make_mesh(8, model_parallel=2)
+    assert mesh.shape == {DATA_AXIS: 4, MODEL_AXIS: 2}
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh(6, model_parallel=4)
+
+
+def test_dataparallel_matches_single_device(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    mesh = make_mesh(8)
+    call = build_sharded_forward(tiny_spec, mesh, dtype=None)
+    sharded_vars = shard_variables(variables, mesh)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(16, *tiny_spec.input_shape), dtype=np.uint8)
+    got = np.asarray(call(sharded_vars, x))
+
+    fwd = jax.jit(build_forward(tiny_spec, dtype=None))
+    want = np.asarray(fwd(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-3)
+
+
+def test_tensor_parallel_sharding_applied(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    mesh = make_mesh(8, model_parallel=2)
+    sharded = shard_variables(variables, mesh)
+    # A wide pointwise kernel (728+ features) must be sharded on its out dim.
+    wide = sharded["params"]["block13_sepconv2"]["pointwise"]["kernel"]
+    spec = wide.sharding.spec
+    assert spec[-1] == MODEL_AXIS
+    # Small kernels stay replicated.
+    small = sharded["params"]["block1_conv1"]["kernel"]
+    assert all(s is None for s in small.sharding.spec)
+
+
+def test_tensor_parallel_forward_matches(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    mesh = make_mesh(8, model_parallel=2)
+    call = build_sharded_forward(tiny_spec, mesh, dtype=None)
+    sharded_vars = shard_variables(variables, mesh)
+    x = np.zeros((8, *tiny_spec.input_shape), np.uint8)
+    got = np.asarray(call(sharded_vars, x))
+    fwd = jax.jit(build_forward(tiny_spec, dtype=None))
+    want = np.asarray(fwd(variables, x))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-3)
+
+
+def test_sharded_engine_bucket_roundup_and_predict(tiny_spec):
+    variables = init_variables(tiny_spec, seed=0)
+    mesh = make_mesh(8)
+    eng = ShardedEngine(tiny_spec, variables, mesh, buckets=(4, 20), dtype=None)
+    # 4 -> 8 (round UP to multiple of 8), 20 -> 24
+    assert eng.buckets == (8, 24)
+    assert eng.max_batch == 24
+    out = eng.predict(np.zeros((5, *tiny_spec.input_shape), np.uint8))
+    assert out.shape == (5, tiny_spec.num_classes)
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.predict(np.zeros((25, *tiny_spec.input_shape), np.uint8))

@@ -1,0 +1,195 @@
+"""End-to-end serving test: gateway -> model server -> engine -> response.
+
+The reference's only test is a live smoke test against a deployed cluster
+(reference test.py:1-16).  Here the same request path runs in-process on the
+CPU backend: a real model server and a real gateway on ephemeral ports, a
+local HTTP server standing in for the image host (no egress in CI), and the
+reference's exact request/response schema asserted end to end.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from functools import partial
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.serving.client import predict_images, predict_url
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """Exported tiny model + model server + gateway + image host, all live."""
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+
+    spec = register_spec(
+        ModelSpec(
+            name="e2e-xception",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("dress", "hat", "pants", "shirt"),
+            preprocessing="tf",
+            resize_filter="nearest",
+        )
+    )
+    root = tmp_path_factory.mktemp("models")
+    variables = init_variables(spec, seed=5)
+    export_model(spec, variables, str(root), dtype=np.float32)
+
+    server = ModelServer(str(root), port=0, buckets=(1, 2, 4), max_delay_ms=1.0)
+    server.warmup()
+    server.start()
+
+    gateway = Gateway(serving_host=f"localhost:{server.port}", model=spec.name, port=0)
+    gateway.start()
+
+    # Local image host: serves a generated PNG (reference's bit.ly stand-in).
+    img_dir = tmp_path_factory.mktemp("images")
+    rng = np.random.default_rng(0)
+    pixels = rng.integers(0, 256, size=(120, 80, 3), dtype=np.uint8)
+    from PIL import Image
+
+    Image.fromarray(pixels).save(img_dir / "pants.png")
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(SimpleHTTPRequestHandler, directory=str(img_dir))
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    image_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/pants.png"
+
+    yield spec, server, gateway, image_url, pixels, variables
+
+    gateway.shutdown()
+    server.shutdown()
+    img_httpd.shutdown()
+
+
+def test_gateway_predict_schema(stack):
+    spec, _, gateway, image_url, _, _ = stack
+    scores = predict_url(f"http://localhost:{gateway.port}", image_url)
+    # Reference response schema: {label: float} for every class
+    # (reference model_server.py:46-49,66).
+    assert set(scores) == set(spec.labels)
+    assert all(isinstance(v, float) for v in scores.values())
+
+
+def test_gateway_matches_direct_forward(stack):
+    import jax
+
+    from kubernetes_deep_learning_tpu.models import build_forward
+    from kubernetes_deep_learning_tpu.ops import preprocess
+
+    spec, _, gateway, image_url, pixels, variables = stack
+    scores = predict_url(f"http://localhost:{gateway.port}", image_url)
+
+    expected_img = preprocess.resize_uint8(pixels, spec.input_shape[:2], "nearest")
+    fwd = jax.jit(build_forward(spec, dtype=None))
+    want = np.asarray(fwd(variables, expected_img[None]))[0]
+    got = np.asarray([scores[l] for l in spec.labels], np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_model_server_batch_predict(stack):
+    spec, server, _, _, _, variables = stack
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 256, size=(3, 96, 96, 3), dtype=np.uint8)
+    logits, labels = predict_images(
+        f"http://localhost:{server.port}", spec.name, imgs
+    )
+    assert logits.shape == (3, 4)
+    assert labels == list(spec.labels)
+
+
+def test_model_server_json_fallback(stack):
+    import requests
+
+    spec, server, _, _, _, _ = stack
+    img = np.zeros((96, 96, 3), np.uint8)
+    r = requests.post(
+        f"http://localhost:{server.port}/v1/models/{spec.name}:predict",
+        json={"instances": [img.tolist()]},
+        timeout=30,
+    )
+    assert r.status_code == 200
+    preds = r.json()["predictions"]
+    assert len(preds) == 1 and set(preds[0]) == set(spec.labels)
+
+
+def test_health_ready_metrics_endpoints(stack):
+    import requests
+
+    spec, server, gateway, image_url, _, _ = stack
+    base_s = f"http://localhost:{server.port}"
+    base_g = f"http://localhost:{gateway.port}"
+    assert requests.get(f"{base_s}/healthz", timeout=5).status_code == 200
+    assert requests.get(f"{base_s}/readyz", timeout=5).status_code == 200
+    assert "kdlt_engine_images_total" in requests.get(f"{base_s}/metrics", timeout=5).text
+    assert requests.get(f"{base_g}/healthz", timeout=5).status_code == 200
+    assert requests.get(f"{base_g}/readyz", timeout=5).status_code == 200
+    assert "kdlt_gateway_requests_total" in requests.get(f"{base_g}/metrics", timeout=5).text
+
+    models = requests.get(f"{base_s}/v1/models", timeout=5).json()
+    assert models[spec.name]["ready"] is True
+    spec_json = requests.get(f"{base_s}/v1/models/{spec.name}", timeout=5).json()
+    assert spec_json["name"] == spec.name
+
+
+def test_error_paths(stack):
+    import requests
+
+    spec, server, gateway, _, _, _ = stack
+    # gateway: bad URL in body
+    r = requests.post(
+        f"http://localhost:{gateway.port}/predict",
+        json={"url": "http://127.0.0.1:1/nope.png"},
+        timeout=30,
+    )
+    assert r.status_code == 400 and "error" in r.json()
+    # gateway: missing url key
+    r = requests.post(f"http://localhost:{gateway.port}/predict", json={}, timeout=30)
+    assert r.status_code == 400
+    # model server: unknown model
+    r = requests.post(
+        f"http://localhost:{server.port}/v1/models/nope:predict", data=b"{}", timeout=30
+    )
+    assert r.status_code == 404
+    # model server: wrong input shape
+    r = requests.post(
+        f"http://localhost:{server.port}/v1/models/{spec.name}:predict",
+        json={"instances": [np.zeros((4, 4, 3), np.uint8).tolist()]},
+        timeout=30,
+    )
+    assert r.status_code == 400 and "shape" in r.json()["error"]
+
+
+def test_concurrent_gateway_requests(stack):
+    spec, _, gateway, image_url, _, _ = stack
+    results = []
+    errors = []
+
+    def hit():
+        try:
+            results.append(predict_url(f"http://localhost:{gateway.port}", image_url))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hit) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors and len(results) == 12
+    # Concurrent identical requests may land in different batch buckets;
+    # each bucket is a separately compiled program, so allow fusion-level
+    # rounding drift (same tolerance story as test_xception.py).
+    first = results[0]
+    for r in results[1:]:
+        for label in first:
+            assert abs(r[label] - first[label]) < 5e-3, (label, r, first)
