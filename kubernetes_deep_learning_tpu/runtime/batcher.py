@@ -63,6 +63,16 @@ class DynamicBatcher:
 
     def submit(self, image: np.ndarray) -> Future:
         """Enqueue one HWC uint8 image; resolves to its logits row."""
+        image = np.asarray(image)
+        expected = getattr(getattr(self._engine, "spec", None), "input_shape", None)
+        if expected is not None and tuple(image.shape) != tuple(expected):
+            raise ValueError(
+                f"image shape {tuple(image.shape)} != expected {tuple(expected)}"
+            )
+        if image.dtype != np.uint8:
+            # np.stack would silently upcast a mixed uint8/float batch and the
+            # uint8 rows would skip normalization; keep the batcher single-dtype.
+            raise ValueError(f"batcher takes uint8 images, got {image.dtype}")
         fut: Future = Future()
         with self._cond:
             if self._closed:
@@ -70,7 +80,7 @@ class DynamicBatcher:
             if len(self._queue) >= self.queue_cap:
                 self._m_queue_full.inc()
                 raise QueueFull("request queue full")
-            self._queue.append((np.asarray(image), fut))
+            self._queue.append((image, fut))
             self._cond.notify()
         return fut
 
@@ -104,9 +114,9 @@ class DynamicBatcher:
             batch = self._take_batch()
             if not batch:
                 return  # closed and drained
-            images = np.stack([img for img, _ in batch])
             self._m_batch_size.observe(len(batch))
             try:
+                images = np.stack([img for img, _ in batch])
                 logits = self._engine.predict(images)
             except Exception as e:  # propagate to all waiters, keep serving
                 for _, fut in batch:

@@ -53,14 +53,19 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._ready = threading.Event()
 
+        from kubernetes_deep_learning_tpu.models import build_forward
+
         if use_exported and artifact.exported_bytes is not None:
             exported = artifact.exported
             fn = exported.call
         else:
-            from kubernetes_deep_learning_tpu.models import build_forward
-
             fn = build_forward(self.spec)
         self._jitted = jax.jit(fn)
+        # The exported module is traced for the uint8 wire path only; float32
+        # "pre-normalized" input (protocol.decode_predict_request's JSON debug
+        # path) runs through the in-tree forward instead.  Compiled lazily --
+        # it is a debug path, not the serving hot loop.
+        self._jitted_f32 = jax.jit(build_forward(self.spec))
 
         registry = registry or metrics_lib.Registry()
         self.registry = registry
@@ -107,6 +112,12 @@ class InferenceEngine:
             raise ValueError(
                 f"expected (N, {self.spec.input_shape}), got {images.shape}"
             )
+        if images.dtype not in (np.uint8, np.float32):
+            raise ValueError(
+                f"dtype {images.dtype} unsupported: send uint8 pixels or "
+                "float32 pre-normalized data"
+            )
+        fn = self._jitted if images.dtype == np.uint8 else self._jitted_f32
         n = images.shape[0]
         bucket = self.bucket_for(n)
         if bucket != n:
@@ -116,7 +127,7 @@ class InferenceEngine:
             batch = images
         t0 = time.perf_counter()
         with self._lock:
-            logits = self._jitted(self._variables, batch)
+            logits = fn(self._variables, batch)
             out = np.asarray(logits)  # device sync
         self._m_infer_latency.observe(time.perf_counter() - t0)
         self._m_images.inc(n)

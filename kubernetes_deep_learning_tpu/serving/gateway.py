@@ -43,6 +43,14 @@ DEFAULT_MODEL = "clothing-model"
 PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
 
 
+class UpstreamError(RuntimeError):
+    """Model-tier failure; surfaces as a retryable 5xx, never a client 400."""
+
+    def __init__(self, msg: str, http_status: int = 502):
+        super().__init__(msg)
+        self.http_status = http_status
+
+
 class Gateway:
     def __init__(
         self,
@@ -56,7 +64,8 @@ class Gateway:
         )
         self.model = model or os.environ.get(MODEL_ENV, DEFAULT_MODEL)
         self._base = f"http://{self.serving_host}"
-        self._local = threading.local()
+        self._session_obj = None
+        self._session_lock = threading.Lock()
         self._spec: ModelSpec | None = None
         self._spec_lock = threading.Lock()
 
@@ -78,22 +87,37 @@ class Gateway:
     # --- model-server client ----------------------------------------------
 
     def _session(self):
+        # One shared Session (thread-safe for plain requests): connections to
+        # the model tier are pooled across handler threads instead of one
+        # fresh TCP setup per short-lived client connection.
         import requests
 
-        if not hasattr(self._local, "session"):
-            self._local.session = requests.Session()
-        return self._local.session
+        if self._session_obj is None:
+            with self._session_lock:
+                if self._session_obj is None:
+                    s = requests.Session()
+                    adapter = requests.adapters.HTTPAdapter(
+                        pool_connections=4, pool_maxsize=64
+                    )
+                    s.mount("http://", adapter)
+                    self._session_obj = s
+        return self._session_obj
 
     @property
     def spec(self) -> ModelSpec:
         """The served model's contract, discovered from the model tier."""
         if self._spec is None:
+            import requests
+
             with self._spec_lock:
                 if self._spec is None:
-                    r = self._session().get(
-                        f"{self._base}/v1/models/{self.model}", timeout=10
-                    )
-                    r.raise_for_status()
+                    try:
+                        r = self._session().get(
+                            f"{self._base}/v1/models/{self.model}", timeout=10
+                        )
+                        r.raise_for_status()
+                    except requests.RequestException as e:
+                        raise UpstreamError(f"model spec discovery failed: {e}") from e
                     self._spec = ModelSpec.from_json(r.text)
         return self._spec
 
@@ -108,15 +132,25 @@ class Gateway:
         )
         self._m_fetch.observe(time.perf_counter() - t0)
 
+        import requests
+
         body = protocol.encode_predict_request(image[None])
-        r = self._session().post(
-            f"{self._base}/v1/models/{self.model}:predict",
-            data=body,
-            headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
-            timeout=PREDICT_TIMEOUT_S,
-        )
+        try:
+            r = self._session().post(
+                f"{self._base}/v1/models/{self.model}:predict",
+                data=body,
+                headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+                timeout=PREDICT_TIMEOUT_S,
+            )
+        except requests.RequestException as e:
+            raise UpstreamError(f"model server unreachable: {e}") from e
         if r.status_code != 200:
-            raise RuntimeError(f"model server error {r.status_code}: {r.text[:200]}")
+            # Pass through the model tier's own overload signal (503 from the
+            # batcher's QueueFull) as retryable; other failures are 502.
+            status = 503 if r.status_code == 503 else 502
+            raise UpstreamError(
+                f"model server error {r.status_code}: {r.text[:200]}", status
+            )
         logits, labels = protocol.decode_predict_response(
             r.content, r.headers.get("Content-Type", "")
         )
@@ -164,7 +198,12 @@ class Gateway:
                     url = req["url"]
                     scores = gw.apply_model(url)
                     self._send(200, json.dumps(scores).encode())
+                except UpstreamError as e:
+                    gw._m_errors.inc()
+                    self._send(e.http_status, json.dumps({"error": str(e)}).encode())
                 except Exception as e:
+                    # Bad JSON, missing "url", unfetchable/undecodable image:
+                    # genuinely the caller's fault.
                     gw._m_errors.inc()
                     self._send(400, json.dumps({"error": str(e)}).encode())
                 finally:

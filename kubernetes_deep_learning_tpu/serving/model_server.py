@@ -62,9 +62,14 @@ class ServedModel:
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         # Multi-image requests go straight to the engine (they are already a
-        # batch); single images go through the batcher to coalesce across
-        # concurrent requests.
-        if self.batcher is not None and images.shape[0] == 1:
+        # batch); single uint8 images go through the batcher to coalesce
+        # across concurrent requests (the batcher is uint8-only so mixed
+        # dtypes never end up in one np.stack).
+        if (
+            self.batcher is not None
+            and images.shape[0] == 1
+            and images.dtype == np.uint8
+        ):
             return self.batcher.predict(images[0])[None]
         return self.engine.predict(images)
 

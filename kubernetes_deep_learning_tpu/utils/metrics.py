@@ -113,9 +113,11 @@ class Histogram:
         with self._lock:
             for le, c in zip(self.buckets, self._counts):
                 cum += c
-                out.append(f'{self.name}_bucket{_fmt_labels(self.labels, f'le="{le}"')} {cum}')
+                le_label = f'le="{le}"'
+                out.append(f"{self.name}_bucket{_fmt_labels(self.labels, le_label)} {cum}")
             cum += self._counts[-1]
-            out.append(f'{self.name}_bucket{_fmt_labels(self.labels, 'le="+Inf"')} {cum}')
+            inf_label = 'le="+Inf"'
+            out.append(f"{self.name}_bucket{_fmt_labels(self.labels, inf_label)} {cum}")
             out.append(f"{self.name}_sum{_fmt_labels(self.labels)} {self._sum}")
             out.append(f"{self.name}_count{_fmt_labels(self.labels)} {self._n}")
         return "\n".join(out) + "\n"
