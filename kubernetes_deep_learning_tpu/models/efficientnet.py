@@ -1,0 +1,158 @@
+"""EfficientNet (B0-scalable, B3 served) in flax.linen.
+
+BASELINE.json config 4 is "EfficientNet-B3 with server-side dynamic batching
+on TPU"; like ResNet50 this family exists to exercise the serving stack with
+a third architecture (the reference serves exactly one model,
+reference tf-serving.dockerfile:4-5).
+
+Architecture follows Tan & Le 2019 (MBConv + squeeze-excite), with compound
+scaling: B3 = width 1.2x, depth 1.4x at 300x300 input.  TPU-first notes:
+depthwise convs use ``feature_group_count`` so XLA emits native depthwise
+ops; squeeze-excite's global pool reduces to a (N,1,1,C) tensor that stays
+on-chip; silu/sigmoid epilogues fuse into the surrounding convs.  Stochastic
+depth is omitted (serving-only framework: it is inference-inert).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+
+from kubernetes_deep_learning_tpu.models.layers import ClassifierHead, batch_norm
+
+# EfficientNet-B0 base blocks: (expand_ratio, channels, repeats, stride, kernel).
+_BASE_BLOCKS = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+_SE_RATIO = 0.25
+
+
+def round_filters(filters: int, width: float, divisor: int = 8) -> int:
+    """Compound-scale a channel count, snapped to a multiple of 8 (MXU-friendly)."""
+    filters *= width
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:  # never round down by more than 10%
+        new += divisor
+    return int(new)
+
+
+def round_repeats(repeats: int, depth: float) -> int:
+    return int(math.ceil(depth * repeats))
+
+
+class SqueezeExcite(nn.Module):
+    """Global-pool -> bottleneck Dense(silu) -> Dense(sigmoid) channel gate."""
+
+    se_features: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = x.mean(axis=(1, 2), keepdims=True)  # (N,1,1,C)
+        s = nn.Conv(self.se_features, (1, 1), dtype=self.dtype, name="reduce")(s)
+        s = nn.silu(s)
+        s = nn.Conv(c, (1, 1), dtype=self.dtype, name="expand")(s)
+        return x * nn.sigmoid(s)
+
+
+class MBConvBlock(nn.Module):
+    """Inverted residual: 1x1 expand -> depthwise kxk -> SE -> 1x1 project."""
+
+    features: int
+    expand_ratio: int
+    kernel: int = 3
+    strides: int = 1
+    se_features: int = 0
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(batch_norm, train, self.dtype)
+
+        c_in = x.shape[-1]
+        y = x
+        if self.expand_ratio != 1:
+            y = conv(c_in * self.expand_ratio, (1, 1), name="expand_conv")(y)
+            y = nn.silu(bn("expand_bn")(y))
+
+        c_mid = y.shape[-1]
+        y = conv(
+            c_mid,
+            (self.kernel, self.kernel),
+            strides=self.strides,
+            feature_group_count=c_mid,
+            padding="SAME",
+            name="dwconv",
+        )(y)
+        y = nn.silu(bn("dw_bn")(y))
+
+        if self.se_features > 0:
+            y = SqueezeExcite(self.se_features, dtype=self.dtype, name="se")(y)
+
+        y = conv(self.features, (1, 1), name="project_conv")(y)
+        y = bn("project_bn")(y)
+
+        if self.strides == 1 and c_in == self.features:
+            y = y + x
+        return y
+
+
+class EfficientNet(nn.Module):
+    num_classes: int
+    width: float = 1.0
+    depth: float = 1.0
+    head_hidden: tuple[int, ...] = ()
+    dropout_rate: float = 0.0
+    dtype: Any = None  # compute dtype; params stay float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(batch_norm, train, self.dtype)
+
+        x = conv(round_filters(32, self.width), (3, 3), strides=2, padding="SAME", name="stem_conv")(x)
+        x = nn.silu(bn("stem_bn")(x))
+
+        block_id = 0
+        for expand, channels, repeats, stride, kernel in _BASE_BLOCKS:
+            features = round_filters(channels, self.width)
+            for rep in range(round_repeats(repeats, self.depth)):
+                c_in = x.shape[-1]
+                x = MBConvBlock(
+                    features,
+                    expand_ratio=expand,
+                    kernel=kernel,
+                    strides=stride if rep == 0 else 1,
+                    se_features=max(1, int(c_in * _SE_RATIO)),
+                    dtype=self.dtype,
+                    name=f"block{block_id}",
+                )(x, train=train)
+                block_id += 1
+
+        x = conv(round_filters(1280, self.width), (1, 1), name="top_conv")(x)
+        x = nn.silu(bn("top_bn")(x))
+
+        return ClassifierHead(
+            self.num_classes,
+            hidden=self.head_hidden,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="head",
+        )(x, train=train)
+
+
+def EfficientNetB3(num_classes: int, dtype: Any = None, **kw) -> EfficientNet:
+    """B3 compound scaling: width 1.2, depth 1.4, input 300x300, dropout 0.3."""
+    kw.setdefault("dropout_rate", 0.3)
+    return EfficientNet(num_classes, width=1.2, depth=1.4, dtype=dtype, **kw)

@@ -1,0 +1,95 @@
+"""ResNet50 (v1, bottleneck) in flax.linen.
+
+BASELINE.json config 3 serves "ResNet50/ImageNet SavedModel ... via same
+gateway path"; the reference itself ships only the Xception clothing model
+(reference convert.py:1-6), so this family exists to prove the serving stack
+is model-agnostic: any ``ModelSpec`` + registered family exports and serves
+through the identical artifact/engine/gateway path.
+
+TPU-first notes: plain NHWC ``nn.Conv`` everywhere (XLA tiles these onto the
+MXU), compute dtype is a module argument (bf16 for serving) with f32 params,
+and the residual adds fuse into the preceding conv epilogues under XLA.
+Layer names mirror ``keras.applications.ResNet50`` (conv1_conv,
+conv2_block1_1_conv, ...) so an .h5 importer can map weights structurally the
+same way models.keras_import does for Xception.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+
+from kubernetes_deep_learning_tpu.models.layers import ClassifierHead, batch_norm
+
+# Keras ResNet50 BatchNormalization epsilon (differs from Xception's 1e-3).
+RESNET_BN_EPS = 1.001e-5
+
+# stage -> (bottleneck width, block count); expansion is 4x.
+_STAGES = ((64, 3), (128, 4), (256, 6), (512, 3))
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand, residual add, post-add relu."""
+
+    features: int          # bottleneck width; output is 4 * features
+    strides: int = 1
+    project: bool = False  # downsample/widen the shortcut with a 1x1 conv
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=True, dtype=self.dtype)
+        bn = partial(batch_norm, train, self.dtype, eps=RESNET_BN_EPS)
+
+        shortcut = x
+        if self.project:
+            shortcut = conv(4 * self.features, (1, 1), strides=self.strides, name="0_conv")(x)
+            shortcut = bn("0_bn")(shortcut)
+
+        y = conv(self.features, (1, 1), strides=self.strides, name="1_conv")(x)
+        y = nn.relu(bn("1_bn")(y))
+        y = conv(self.features, (3, 3), padding="SAME", name="2_conv")(y)
+        y = nn.relu(bn("2_bn")(y))
+        y = conv(4 * self.features, (1, 1), name="3_conv")(y)
+        y = bn("3_bn")(y)
+        return nn.relu(y + shortcut)
+
+
+class ResNet50(nn.Module):
+    num_classes: int
+    head_hidden: tuple[int, ...] = ()
+    dropout_rate: float = 0.0
+    dtype: Any = None  # compute dtype; params stay float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=True, dtype=self.dtype)
+        bn = partial(batch_norm, train, self.dtype, eps=RESNET_BN_EPS)
+
+        # Stem: 7x7/2 conv (Keras pads 3px then VALID; SAME matches for 224).
+        x = conv(64, (7, 7), strides=2, padding=[(3, 3), (3, 3)], name="conv1_conv")(x)
+        x = nn.relu(bn("conv1_bn")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        for stage_idx, (features, blocks) in enumerate(_STAGES, start=2):
+            for block_idx in range(1, blocks + 1):
+                # First block of each stage projects; stage 2 keeps stride 1
+                # (the stem's max_pool already downsampled).
+                strides = 2 if (block_idx == 1 and stage_idx > 2) else 1
+                x = BottleneckBlock(
+                    features,
+                    strides=strides,
+                    project=block_idx == 1,
+                    dtype=self.dtype,
+                    name=f"conv{stage_idx}_block{block_idx}",
+                )(x, train=train)
+
+        return ClassifierHead(
+            self.num_classes,
+            hidden=self.head_hidden,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="head",
+        )(x, train=train)

@@ -55,17 +55,32 @@ class InferenceEngine:
 
         from kubernetes_deep_learning_tpu.models import build_forward
 
+        # Compute dtype recorded at export time; the f32 debug path must use
+        # the same dtype or it would disagree numerically with the wire path.
+        self._compute_dtype = artifact.metadata.get("compute_dtype", "bfloat16")
         if use_exported and artifact.exported_bytes is not None:
-            exported = artifact.exported
-            fn = exported.call
+            self._jitted = jax.jit(artifact.exported.call)
+            # The exported module is traced for the uint8 wire path only;
+            # float32 "pre-normalized" input (protocol.decode_predict_request's
+            # JSON debug path) runs through the in-tree forward instead,
+            # built lazily: a StableHLO artifact stays servable even when its
+            # spec.family has no in-tree model, and the (slow) build/compile
+            # is deferred to first debug use.
+            self._jitted_f32 = None
         else:
-            fn = build_forward(self.spec)
-        self._jitted = jax.jit(fn)
-        # The exported module is traced for the uint8 wire path only; float32
-        # "pre-normalized" input (protocol.decode_predict_request's JSON debug
-        # path) runs through the in-tree forward instead.  Compiled lazily --
-        # it is a debug path, not the serving hot loop.
-        self._jitted_f32 = jax.jit(build_forward(self.spec))
+            # build_forward branches on input dtype at trace time and jit
+            # specializes per dtype, so one jitted fn serves both paths.
+            import jax.numpy as jnp
+
+            self._jitted = jax.jit(
+                build_forward(self.spec, dtype=jnp.dtype(self._compute_dtype))
+            )
+            self._jitted_f32 = self._jitted
+        # The f32 debug path dispatches under its own lock: its lazy first
+        # compile (tens of seconds on TPU) must never stall warm uint8
+        # traffic serialized on _lock.  Concurrent dispatch of two programs
+        # is safe -- the device runtime serializes execution.
+        self._f32_lock = threading.Lock()
 
         registry = registry or metrics_lib.Registry()
         self.registry = registry
@@ -99,6 +114,21 @@ class InferenceEngine:
         self._ready.set()
         return dt
 
+    def _f32_forward(self):
+        """Lazily build the float32 debug-path fn (exported artifacts only)."""
+        if self._jitted_f32 is None:
+            with self._f32_lock:
+                if self._jitted_f32 is None:
+                    import jax
+                    import jax.numpy as jnp
+
+                    from kubernetes_deep_learning_tpu.models import build_forward
+
+                    self._jitted_f32 = jax.jit(
+                        build_forward(self.spec, dtype=jnp.dtype(self._compute_dtype))
+                    )
+        return self._jitted_f32
+
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
             if b >= n:
@@ -117,7 +147,8 @@ class InferenceEngine:
                 f"dtype {images.dtype} unsupported: send uint8 pixels or "
                 "float32 pre-normalized data"
             )
-        fn = self._jitted if images.dtype == np.uint8 else self._jitted_f32
+        hot = images.dtype == np.uint8
+        fn = self._jitted if hot else self._f32_forward()
         n = images.shape[0]
         bucket = self.bucket_for(n)
         if bucket != n:
@@ -126,10 +157,13 @@ class InferenceEngine:
         else:
             batch = images
         t0 = time.perf_counter()
-        with self._lock:
+        with self._lock if hot else self._f32_lock:
             logits = fn(self._variables, batch)
             out = np.asarray(logits)  # device sync
-        self._m_infer_latency.observe(time.perf_counter() - t0)
+        if hot:
+            # The debug path's lazy first compile would otherwise land a
+            # tens-of-seconds sample in the serving latency histogram.
+            self._m_infer_latency.observe(time.perf_counter() - t0)
         self._m_images.inc(n)
         self._m_batches.inc()
         self._m_pad_waste.inc(bucket - n)

@@ -151,9 +151,14 @@ class Gateway:
             raise UpstreamError(
                 f"model server error {r.status_code}: {r.text[:200]}", status
             )
-        logits, labels = protocol.decode_predict_response(
-            r.content, r.headers.get("Content-Type", "")
-        )
+        try:
+            logits, labels = protocol.decode_predict_response(
+                r.content, r.headers.get("Content-Type", "")
+            )
+        except Exception as e:
+            # A 200 with an undecodable body is the model tier's fault
+            # (truncated response, content-type mismatch), never the client's.
+            raise UpstreamError(f"malformed model server response: {e}") from e
         return dict(zip(labels, map(float, logits[0])))
 
     # --- HTTP plumbing ----------------------------------------------------
