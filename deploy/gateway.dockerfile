@@ -1,0 +1,27 @@
+# Serving-gateway image: the reference's gateway.dockerfile equivalent
+# (python:3.7.5-slim + pipenv + gunicorn, reference gateway.dockerfile:1-16).
+#
+# Build (repo root):
+#   docker build -t kdlt-gateway -f deploy/gateway.dockerfile .
+#
+# Differences, deliberate: dependency pinning is pyproject-based instead of
+# Pipfile; the server is the in-tree threaded gateway (stdlib, one process,
+# pooled upstream connections) instead of gunicorn sync workers -- the gateway
+# is pure IO, so threads beat pre-fork here (no GIL-bound compute; each worker
+# process would otherwise hold its own upstream connection pool).  The gateway
+# never imports jax: image stays small and boots instantly.
+
+FROM python:3.11-slim
+
+ENV PYTHONUNBUFFERED=TRUE
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
+RUN pip install --no-cache-dir .
+
+EXPOSE 9696
+# Model-tier discovery via KDLT_SERVING_HOST (k8s DNS), localhost fallback for
+# docker-compose style local runs -- the reference's TF_SERVING_HOST pattern
+# (reference model_server.py:13, serving-gateway-deployment.yaml:22-24).
+ENTRYPOINT ["kdlt-gateway", "--port", "9696"]

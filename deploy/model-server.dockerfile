@@ -1,0 +1,33 @@
+# TPU model-server image: the in-tree replacement for the reference's
+# tf-serving.dockerfile (tensorflow/serving:2.3.0 + baked-in SavedModel,
+# reference tf-serving.dockerfile:1-5).  Same pattern: base runtime, bake the
+# versioned model artifact into /models, select the model via env.
+#
+# Build (repo root):
+#   docker build -t kdlt-model-server -f deploy/model-server.dockerfile .
+# The artifact is produced beforehand with:
+#   kdlt-export --model clothing-model --weights xception_v4.h5 --output ./models
+#
+# GPU-vs-CPU in the reference is a one-line image swap (tf-serving.dockerfile:1);
+# here TPU-vs-CPU is one pip extra: jax[tpu] resolves the TPU PJRT plugin on a
+# GKE TPU node, and the identical image falls back to CPU off-TPU (the exported
+# StableHLO is lowered for both platforms, export/exporter.py DEFAULT_PLATFORMS).
+
+FROM python:3.11-slim
+
+ENV PYTHONUNBUFFERED=TRUE
+
+RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || \
+    pip install --no-cache-dir jax
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
+RUN pip install --no-cache-dir .
+
+# Versioned artifact layout /models/<name>/<version>/ -- the same convention
+# the reference bakes its SavedModel with (tf-serving.dockerfile:5).
+COPY models /models
+
+EXPOSE 8500
+ENTRYPOINT ["kdlt-model-server", "--models", "/models", "--port", "8500"]

@@ -73,8 +73,17 @@ def export_model(
         "compute_dtype": jnp.dtype(dtype).name,
         "framework_version": __import__("kubernetes_deep_learning_tpu").__version__,
     }
+    # Write-then-rename so a concurrently polling model server (its version
+    # watcher scans every few seconds) can never observe a half-written
+    # version dir; dot-prefixed temp names are invisible to the numeric
+    # version scan (artifact.scan_versions).
+    import os
+
     directory = art.version_dir(root, spec.name, version)
-    return art.save_artifact(directory, spec, variables, exported_bytes, metadata)
+    staging = os.path.join(os.path.dirname(directory), f".tmp-{version}")
+    art.save_artifact(staging, spec, variables, exported_bytes, metadata)
+    os.rename(staging, directory)
+    return directory
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,131 @@
+"""ctypes binding for the native host ops (native/hostops.cc).
+
+Importing this module either finds a prebuilt ``libkdlthostops.so`` (env
+``KDLT_NATIVE_LIB``, the package directory, or ``native/build/``) or compiles
+one with g++ into a per-user cache.  Any failure raises ImportError, which
+``ops.preprocess`` treats as "no native path" and falls back to PIL -- the
+package must keep working on machines without a toolchain.
+
+The resize kernels are bit-exact with PIL's (see hostops.cc), verified by
+tests/test_native.py, so the gateway can use whichever is available without
+perturbing golden logits.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+
+_LIB_NAME = "libkdlthostops.so"
+
+
+def _repo_native_dir() -> str | None:
+    # <repo>/kubernetes_deep_learning_tpu/ops/_native.py -> <repo>/native
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidate = os.path.join(os.path.dirname(pkg), "native")
+    return candidate if os.path.isfile(os.path.join(candidate, "hostops.cc")) else None
+
+
+def _build(source_dir: str) -> str:
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "kdlt",
+    )
+    os.makedirs(cache, exist_ok=True)
+    src = os.path.join(source_dir, "hostops.cc")
+    out = os.path.join(cache, _LIB_NAME)
+    if os.path.isfile(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-o", out, src, "-pthread"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def _find_or_build() -> str:
+    explicit = os.environ.get("KDLT_NATIVE_LIB")
+    if explicit:
+        return explicit
+    here = os.path.dirname(os.path.abspath(__file__))
+    for candidate in (
+        os.path.join(here, _LIB_NAME),
+        os.path.join(os.path.dirname(os.path.dirname(here)), "native", "build", _LIB_NAME),
+    ):
+        if os.path.isfile(candidate):
+            return candidate
+    native_dir = _repo_native_dir()
+    if native_dir is None:
+        raise ImportError("no prebuilt libkdlthostops.so and no source tree")
+    return _build(native_dir)
+
+
+try:
+    _lib = ctypes.CDLL(_find_or_build())
+except Exception as e:  # toolchain or source missing: PIL fallback
+    raise ImportError(f"native host ops unavailable: {e}") from e
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+for _fn, _args in (
+    ("kdlt_resize_bilinear", [_u8p] + [ctypes.c_int] * 3 + [_u8p] + [ctypes.c_int] * 2),
+    ("kdlt_resize_nearest", [_u8p] + [ctypes.c_int] * 3 + [_u8p] + [ctypes.c_int] * 2),
+    ("kdlt_resize_batch", [_u8p] + [ctypes.c_int] * 4 + [_u8p] + [ctypes.c_int] * 4),
+):
+    fn = getattr(_lib, _fn)
+    fn.argtypes = _args
+    fn.restype = ctypes.c_int
+
+
+def _check(img: np.ndarray) -> np.ndarray:
+    img = np.ascontiguousarray(img)
+    if img.dtype != np.uint8 or img.ndim != 3:
+        raise ValueError(f"expected uint8 HWC array, got {img.dtype} {img.shape}")
+    return img
+
+
+def resize_bilinear(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    img = _check(img)
+    out = np.empty((h, w, img.shape[2]), np.uint8)
+    rc = _lib.kdlt_resize_bilinear(
+        img.ctypes.data_as(_u8p), img.shape[0], img.shape[1], img.shape[2],
+        out.ctypes.data_as(_u8p), h, w,
+    )
+    if rc != 0:
+        raise ValueError(f"kdlt_resize_bilinear failed (rc={rc})")
+    return out
+
+
+def resize_nearest(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    img = _check(img)
+    out = np.empty((h, w, img.shape[2]), np.uint8)
+    rc = _lib.kdlt_resize_nearest(
+        img.ctypes.data_as(_u8p), img.shape[0], img.shape[1], img.shape[2],
+        out.ctypes.data_as(_u8p), h, w,
+    )
+    if rc != 0:
+        raise ValueError(f"kdlt_resize_nearest failed (rc={rc})")
+    return out
+
+
+def resize_batch(
+    imgs: np.ndarray, h: int, w: int, filter: str = "bilinear", num_threads: int = 0
+) -> np.ndarray:
+    """Resize a (N,H,W,C) uint8 batch; shards across C++ threads (GIL-free)."""
+    imgs = np.ascontiguousarray(imgs)
+    if imgs.dtype != np.uint8 or imgs.ndim != 4:
+        raise ValueError(f"expected uint8 NHWC array, got {imgs.dtype} {imgs.shape}")
+    n, _, _, c = imgs.shape
+    if num_threads <= 0:
+        num_threads = min(n, os.cpu_count() or 1)
+    out = np.empty((n, h, w, c), np.uint8)
+    rc = _lib.kdlt_resize_batch(
+        imgs.ctypes.data_as(_u8p), n, imgs.shape[1], imgs.shape[2], c,
+        out.ctypes.data_as(_u8p), h, w,
+        {"nearest": 0, "bilinear": 1}[filter], num_threads,
+    )
+    if rc != 0:
+        raise ValueError(f"kdlt_resize_batch failed (rc={rc})")
+    return out

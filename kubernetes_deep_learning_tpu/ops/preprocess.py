@@ -76,14 +76,18 @@ def resize_uint8(
     ``filter`` comes from ModelSpec.resize_filter: the clothing model uses
     "nearest" because keras-image-helper (the reference's preprocessor,
     reference model_server.py:18) resizes with Image.NEAREST, and the filter
-    choice shifts logits far beyond numerical tolerance.  Uses the C++ kernel
-    when built (bilinear only), else PIL.  Both paths produce uint8 HWC.
+    choice shifts logits far beyond numerical tolerance.  Uses the in-tree
+    C++ kernel when available (native/hostops.cc -- bit-exact with PIL for
+    both filters, tests/test_native.py), else PIL.
     """
+    if filter not in ("bilinear", "nearest"):
+        raise ValueError(f"unknown resize filter {filter!r}")
     h, w = int(size[0]), int(size[1])
     if img.shape[0] == h and img.shape[1] == w:
         return np.ascontiguousarray(img)
-    if filter == "bilinear" and _native is not None:
-        return _native.resize_bilinear(img, h, w)
+    if _native is not None:
+        fn = _native.resize_bilinear if filter == "bilinear" else _native.resize_nearest
+        return fn(img, h, w)
     from PIL import Image
 
     filters = {"bilinear": Image.BILINEAR, "nearest": Image.NEAREST}

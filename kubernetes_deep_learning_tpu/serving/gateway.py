@@ -217,6 +217,7 @@ class Gateway:
         return Handler
 
     def start(self, block: bool = False) -> None:
+        self._serving = True
         if block:
             self._httpd.serve_forever()
         else:
@@ -226,7 +227,10 @@ class Gateway:
             self._thread.start()
 
     def shutdown(self) -> None:
-        self._httpd.shutdown()
+        # See ModelServer.shutdown: BaseServer.shutdown() hangs if
+        # serve_forever never ran.
+        if getattr(self, "_serving", False):
+            self._httpd.shutdown()
         self._httpd.server_close()
 
 

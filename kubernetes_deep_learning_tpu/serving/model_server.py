@@ -37,7 +37,12 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 import numpy as np
 
 from kubernetes_deep_learning_tpu.export import artifact as art
-from kubernetes_deep_learning_tpu.runtime import DynamicBatcher, InferenceEngine, QueueFull
+from kubernetes_deep_learning_tpu.runtime import (
+    BatcherClosed,
+    DynamicBatcher,
+    InferenceEngine,
+    QueueFull,
+)
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
@@ -49,16 +54,31 @@ DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-mode
 class ServedModel:
     def __init__(self, artifact, buckets, max_delay_ms, registry, use_batcher=True):
         self.artifact = artifact
-        # Each model gets a labeled child registry so two models' engines
-        # never emit colliding metric series on the shared /metrics page.
-        model_registry = registry.with_labels(model=artifact.spec.name)
-        self.engine = InferenceEngine(artifact, buckets=buckets, registry=model_registry)
-        self.batcher = (
-            DynamicBatcher(self.engine, max_delay_ms=max_delay_ms, registry=model_registry)
-            if use_batcher
-            else None
-        )
         self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
+        # Each model version gets a labeled child registry so two models (or
+        # two versions across a hot reload) never emit colliding series on
+        # the shared /metrics page; the child is dropped when the version is
+        # unloaded (ModelServer.poll_versions).
+        self.registry_child = registry.with_labels(
+            model=artifact.spec.name, version=str(self.version)
+        )
+        try:
+            self.engine = InferenceEngine(
+                artifact, buckets=buckets, registry=self.registry_child
+            )
+            self.batcher = (
+                DynamicBatcher(
+                    self.engine, max_delay_ms=max_delay_ms, registry=self.registry_child
+                )
+                if use_batcher
+                else None
+            )
+        except BaseException:
+            # with_labels already hooked the child into the shared registry;
+            # a failed construction must not leave the orphan behind (the
+            # version watcher retries every poll).
+            registry.remove(self.registry_child)
+            raise
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         # Multi-image requests go straight to the engine (they are already a
@@ -70,8 +90,19 @@ class ServedModel:
             and images.shape[0] == 1
             and images.dtype == np.uint8
         ):
-            return self.batcher.predict(images[0])[None]
+            try:
+                return self.batcher.predict(images[0])[None]
+            except BatcherClosed:
+                # A hot reload closed this version's batcher while the
+                # handler already held a reference to it; the engine is
+                # still valid, so the in-flight request must not become
+                # a client-visible 500.
+                pass
         return self.engine.predict(images)
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close(drain=True)
 
 
 class ModelServer:
@@ -96,28 +127,18 @@ class ModelServer:
         )
         self.models: dict[str, ServedModel] = {}
         self.model_root = model_root
-        self._load_all(buckets, max_delay_ms, use_batcher)
+        self._buckets = buckets
+        self._max_delay_ms = max_delay_ms
+        self._use_batcher = use_batcher
+        self._watcher: threading.Thread | None = None
+        self._watcher_stop = threading.Event()
+        self.poll_versions()
+        if not self.models:
+            raise FileNotFoundError(f"no model artifacts under {model_root!r}")
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
-
-    def _load_all(self, buckets, max_delay_ms, use_batcher) -> None:
-        import os
-
-        names = sorted(os.listdir(self.model_root)) if os.path.isdir(self.model_root) else []
-        for name in names:
-            version = art.latest_version(self.model_root, name)
-            if version is None:
-                continue
-            directory = art.version_dir(self.model_root, name, version)
-            artifact = art.load_artifact(directory)
-            self.models[artifact.spec.name] = ServedModel(
-                artifact, buckets, max_delay_ms, self.registry, use_batcher
-            )
-            print(f"loaded {artifact.spec.name} v{version} from {directory}")
-        if not self.models:
-            raise FileNotFoundError(f"no model artifacts under {self.model_root!r}")
 
     def warmup(self) -> None:
         for m in self.models.values():
@@ -127,6 +148,91 @@ class ModelServer:
     @property
     def ready(self) -> bool:
         return all(m.engine.ready for m in self.models.values())
+
+    # --- version watching --------------------------------------------------
+
+    def poll_versions(self) -> list[str]:
+        """One scan of the artifact root: load any new model or higher version.
+
+        TF-Serving's convention -- watch /models/<name>/ and hot-load the
+        highest numeric version dir (SURVEY.md section 5, checkpoint/resume) --
+        which the reference ships but never exercises (it redeploys the image
+        instead, reference tf-serving.dockerfile:5).  Serves as both the
+        initial load (from __init__) and the watcher's periodic scan.
+
+        Concurrency contract: a new version is fully loaded and **warmed
+        before the swap**, so serving never routes to a cold engine; the
+        swap rebinds ``self.models`` to a fresh dict (copy-on-write), so
+        handler threads iterating the old snapshot never see a mutation.
+        Layout invariant: the artifact's spec.name must equal its directory
+        name -- it is the serving key, URL path, and version-comparison key
+        at once; mismatched artifacts are skipped loudly.  Returns "name vN"
+        per swap.
+        """
+        import os
+
+        updated: list[str] = []
+        names = (
+            sorted(os.listdir(self.model_root))
+            if os.path.isdir(self.model_root)
+            else []
+        )
+        for name in names:
+            version = art.latest_version(self.model_root, name)
+            if version is None:
+                continue
+            current = self.models.get(name)
+            if current is not None and current.version >= version:
+                continue
+            directory = art.version_dir(self.model_root, name, version)
+            fresh = None
+            try:
+                artifact = art.load_artifact(directory)
+                if artifact.spec.name != name:
+                    print(
+                        f"version watcher: skipping {directory}: spec.name "
+                        f"{artifact.spec.name!r} != directory name {name!r}"
+                    )
+                    continue
+                fresh = ServedModel(
+                    artifact,
+                    self._buckets,
+                    self._max_delay_ms,
+                    self.registry,
+                    self._use_batcher,
+                )
+                fresh.engine.warmup()
+            except Exception as e:
+                # A half-written or broken version dir must never take down
+                # the serving versions; skip and retry on the next poll.
+                if fresh is not None:  # warmup failed post-construction
+                    fresh.close()
+                    self.registry.remove(fresh.registry_child)
+                print(f"version watcher: skipping {name} v{version}: {e}")
+                continue
+            old = self.models.get(name)
+            self.models = {**self.models, name: fresh}
+            if old is not None:
+                old.close()
+                self.registry.remove(old.registry_child)
+            updated.append(f"{name} v{version}")
+            print(f"loaded {name} v{version} from {directory}")
+        return updated
+
+    def start_version_watcher(self, interval_s: float = 10.0) -> None:
+        """Poll the artifact root for new versions in a daemon thread."""
+
+        def loop():
+            while not self._watcher_stop.wait(interval_s):
+                try:
+                    self.poll_versions()
+                except Exception as e:
+                    print(f"version watcher error: {e}")
+
+        self._watcher = threading.Thread(
+            target=loop, name="kdlt-version-watcher", daemon=True
+        )
+        self._watcher.start()
 
     # --- HTTP plumbing -----------------------------------------------------
 
@@ -221,6 +327,7 @@ class ModelServer:
         return Handler
 
     def start(self, block: bool = False) -> None:
+        self._serving = True
         if block:
             self._httpd.serve_forever()
         else:
@@ -230,7 +337,14 @@ class ModelServer:
             self._thread.start()
 
     def shutdown(self) -> None:
-        self._httpd.shutdown()
+        self._watcher_stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+        # BaseServer.shutdown() blocks on serve_forever's exit event; only
+        # call it if serve_forever actually ran (a constructed-but-never-
+        # started server is a legitimate lifecycle, e.g. load-only tooling).
+        if getattr(self, "_serving", False):
+            self._httpd.shutdown()
         self._httpd.server_close()
         for m in self.models.values():
             if m.batcher is not None:
@@ -244,6 +358,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--buckets", default="1,2,4,8,16,32,64,128")
     p.add_argument("--max-delay-ms", type=float, default=2.0)
     p.add_argument("--no-batching", action="store_true")
+    p.add_argument(
+        "--watch-interval",
+        type=float,
+        default=10.0,
+        help="seconds between artifact-root scans for new versions (0 = off)",
+    )
     p.add_argument(
         "--platform",
         default=None,
@@ -263,6 +383,8 @@ def main(argv: list[str] | None = None) -> int:
         use_batcher=not args.no_batching,
     )
     server.warmup()
+    if args.watch_interval > 0:
+        server.start_version_watcher(args.watch_interval)
     print(f"model server listening on :{server.port}")
     server.start(block=True)
     return 0

@@ -159,6 +159,18 @@ class Registry:
             self._metrics.append(m)
         return m
 
+    def remove(self, m) -> None:
+        """Drop a metric or child registry (e.g. an unloaded model version's
+        series) from this registry's output."""
+        with self._lock:
+            if m in self._metrics:
+                self._metrics.remove(m)
+                name = getattr(m, "name", None)
+                if name is not None:
+                    self._keys.discard(
+                        (name, tuple(sorted((m.labels or {}).items())))
+                    )
+
     def render(self) -> str:
         with self._lock:
             return "".join(m.render() for m in self._metrics)

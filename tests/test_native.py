@@ -1,0 +1,67 @@
+"""Native C++ host ops: bit-exact parity with the PIL fallback.
+
+The native resize (native/hostops.cc) replaces PIL on the gateway hot path;
+both filters must agree with PIL **exactly** -- the clothing model's golden
+logits depend on nearest-resize pixel identity (modelspec.py, BASELINE.md),
+so "close" is not good enough.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from PIL import Image
+
+_native = pytest.importorskip(
+    "kubernetes_deep_learning_tpu.ops._native",
+    reason="native lib unavailable (no g++?)",
+)
+
+SIZES = [
+    ((120, 80), (96, 96)),     # down
+    ((50, 60), (299, 299)),    # up (exercises PIL's incremental-accumulation quirk)
+    ((500, 400), (299, 299)),  # down to flagship resolution
+    ((299, 299), (150, 100)),  # non-square down
+    ((3, 5), (7, 2)),          # degenerate tiny
+]
+
+
+@pytest.mark.parametrize("src_size,dst_size", SIZES)
+@pytest.mark.parametrize("filt", ["nearest", "bilinear"])
+def test_resize_matches_pil_exactly(src_size, dst_size, filt):
+    rng = np.random.default_rng(hash((src_size, dst_size)) % 2**32)
+    img = rng.integers(0, 256, (*src_size, 3), dtype=np.uint8)
+    (dh, dw) = dst_size
+    pil_filter = Image.NEAREST if filt == "nearest" else Image.BILINEAR
+    want = np.asarray(Image.fromarray(img).resize((dw, dh), pil_filter), np.uint8)
+    fn = _native.resize_nearest if filt == "nearest" else _native.resize_bilinear
+    np.testing.assert_array_equal(fn(img, dh, dw), want)
+
+
+@pytest.mark.parametrize("filt", ["nearest", "bilinear"])
+def test_resize_batch_matches_single(filt):
+    rng = np.random.default_rng(7)
+    imgs = rng.integers(0, 256, (5, 120, 80, 3), dtype=np.uint8)
+    batch = _native.resize_batch(imgs, 64, 48, filter=filt, num_threads=3)
+    single = _native.resize_nearest if filt == "nearest" else _native.resize_bilinear
+    for i in range(imgs.shape[0]):
+        np.testing.assert_array_equal(batch[i], single(imgs[i], 64, 48))
+
+
+def test_preprocess_uses_native_and_matches_pil():
+    from kubernetes_deep_learning_tpu.ops import preprocess
+
+    assert preprocess._native is not None
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 256, (200, 150, 3), dtype=np.uint8)
+    for filt, pil_filter in (("nearest", Image.NEAREST), ("bilinear", Image.BILINEAR)):
+        got = preprocess.resize_uint8(img, (96, 96), filt)
+        want = np.asarray(Image.fromarray(img).resize((96, 96), pil_filter), np.uint8)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_input_validation():
+    with pytest.raises(ValueError):
+        _native.resize_bilinear(np.zeros((4, 4), np.uint8), 2, 2)  # not HWC
+    with pytest.raises(ValueError):
+        _native.resize_nearest(np.zeros((4, 4, 3), np.float32), 2, 2)  # not uint8

@@ -1,0 +1,83 @@
+"""Version hot-reload: the TF-Serving version-watching convention, in-tree.
+
+The reference bakes exactly one version into the image and redeploys to
+update (reference tf-serving.dockerfile:5); the underlying TF-Serving binary
+would hot-load a higher-numbered dir.  Our server implements that convention:
+poll_versions() scans /models/<name>/ and atomically swaps in new warmed
+versions (serving/model_server.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+
+@pytest.fixture(scope="module")
+def reload_spec() -> ModelSpec:
+    return register_spec(
+        ModelSpec(
+            name="reload-model",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+        )
+    )
+
+
+def test_hot_reload_new_version(reload_spec, tmp_path):
+    root = str(tmp_path)
+    v1_vars = init_variables(reload_spec, seed=1)
+    export_model(reload_spec, v1_vars, root, dtype=np.float32)
+
+    server = ModelServer(root, port=0, buckets=(1, 2), max_delay_ms=1.0)
+    try:
+        server.warmup()
+        assert server.models[reload_spec.name].version == 1
+
+        x = np.zeros((1, 96, 96, 3), np.uint8)
+        logits_v1 = server.models[reload_spec.name].predict(x)
+
+        # Nothing new on disk -> no-op poll.
+        assert server.poll_versions() == []
+
+        # Drop version 2 with different weights; poll must swap it in warmed.
+        v2_vars = init_variables(reload_spec, seed=2)
+        export_model(reload_spec, v2_vars, root, dtype=np.float32)
+        assert server.poll_versions() == [f"{reload_spec.name} v2"]
+        served = server.models[reload_spec.name]
+        assert served.version == 2
+        assert served.engine.ready  # warmed before the swap
+        assert server.ready
+
+        logits_v2 = served.predict(x)
+        assert not np.allclose(logits_v1, logits_v2)  # weights actually changed
+
+        # Old version's metric series dropped, new version's present.
+        page = server.registry.render()
+        assert 'version="2"' in page
+        assert 'version="1"' not in page
+    finally:
+        server.shutdown()
+
+
+def test_broken_version_dir_is_skipped(reload_spec, tmp_path):
+    root = str(tmp_path)
+    export_model(reload_spec, init_variables(reload_spec, seed=1), root, dtype=np.float32)
+    server = ModelServer(root, port=0, buckets=(1,), max_delay_ms=1.0)
+    try:
+        server.warmup()
+        # A half-written version dir (no artifact files) must not take down
+        # the serving version.
+        (tmp_path / reload_spec.name / "2").mkdir()
+        assert server.poll_versions() == []
+        assert server.models[reload_spec.name].version == 1
+        assert server.ready
+    finally:
+        server.shutdown()
