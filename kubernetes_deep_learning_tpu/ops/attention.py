@@ -1,0 +1,205 @@
+"""Attention ops: fused Pallas flash attention (TPU) + reference softmax path.
+
+The reference system serves a fixed-shape CNN and has no attention anywhere
+(SURVEY.md section 5: long-context "absent and inapplicable"); this module
+exists because long-context support is first-class in this framework: it is
+the single-device building block under ``parallel.ring`` (ring attention /
+context parallelism over a device mesh).
+
+Design (TPU-first):
+
+- **Online softmax** (flash attention): the (S, S) score matrix is never
+  materialized in HBM.  The Pallas kernel keeps one (block_q, d) query tile
+  in VMEM and streams key/value tiles through a fori_loop, carrying the
+  running row-max m, normalizer l, and unnormalized accumulator in f32.
+- **MXU-shaped blocks**: default 128x128 score tiles, f32 accumulation via
+  ``preferred_element_type`` so bf16 inputs still reduce exactly.
+- **Partial outputs for ring composition**: ``attend_block`` returns
+  (acc, m, l) so callers (ring attention) can combine partial attentions
+  over KV shards with the standard log-sum-exp merge; ``flash_attention``
+  is the fused single-shot form.
+- ``interpret=True`` (auto on CPU) runs the same kernel through the Pallas
+  interpreter, so tests exercise the real kernel logic without a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: -inf breaks exp(m - m_new) when a row is fully masked
+
+
+def _causal_mask(q_offset: int, k_offset, block_q: int, block_k: int):
+    """(block_q, block_k) bool mask: query global index >= key global index."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_offset
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_offset
+    return rows >= cols
+
+
+def mha_reference(q, k, v, *, causal: bool = False, k_offset: int = 0):
+    """Plain softmax attention, (..., S, D) layout.  Ground truth for tests.
+
+    ``k_offset`` is the global position of k[0] relative to q[0] (used when
+    the KV block is a remote shard in ring attention).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(0, k_offset, q.shape[-2], k.shape[-2])
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+
+def attend_block(q, k, v, *, causal: bool = False, k_offset: int = 0):
+    """Unnormalized attention partials of q against one KV block.
+
+    Returns ``(acc, m, l)`` with acc: (..., S_q, D) f32 unnormalized output,
+    m: (..., S_q) f32 row max, l: (..., S_q) f32 row sum of exp(s - m).
+    Partials over different KV blocks combine with ``combine_partials``;
+    ``acc / l`` recovers the softmax-attention output.  This is the ring
+    attention inner step (parallel.ring).
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = _causal_mask(0, k_offset, q.shape[-2], k.shape[-2])
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def combine_partials(a, b):
+    """Merge two (acc, m, l) partials (log-sum-exp over the KV axis)."""
+    acc_a, m_a, l_a = a
+    acc_b, m_b, l_b = b
+    m = jnp.maximum(m_a, m_b)
+    alpha = jnp.exp(m_a - m)
+    beta = jnp.exp(m_b - m)
+    return (
+        acc_a * alpha[..., None] + acc_b * beta[..., None],
+        m,
+        l_a * alpha + l_b * beta,
+    )
+
+
+def finalize_partials(partial):
+    acc, _, l = partial
+    return acc / l[..., None]
+
+
+# --- Pallas fused kernel ---------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset):
+    """One (1, block_q, d) query tile vs the full local KV, online softmax."""
+    q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+    block_q, d = q.shape
+    seq_k = k_ref.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    q_start = pl.program_id(1) * block_q
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                              # (block_q, block_k)
+        if causal:
+            mask = _causal_mask(q_start, j * block_k + k_offset, block_q, block_k)
+            s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, seq_k // block_k, body, (acc, m, l))
+    # A row masked across EVERY key (causal with k_offset pushing the whole
+    # block into the future) ends with m still at NEG_INF and p=exp(0)=1
+    # everywhere, i.e. acc/l = mean(v); define empty-softmax as zeros instead.
+    masked = m <= NEG_INF * 0.5
+    o_ref[0] = jnp.where(masked, 0.0, acc / l).astype(o_ref.dtype)
+
+
+try:  # pallas needs a recent jaxlib; keep the module importable without it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    k_offset: int = 0,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Fused flash attention.  q, k, v: (B, H, S, D) -> (B, H, S, D).
+
+    The full local KV for one (batch, head) lives in VMEM while query tiles
+    stream over it, so S_local * D must fit VMEM (~16 MB/core) -- e.g.
+    S=8192 at D=128 bf16 is 2 MB/tensor.  Longer sequences shard S over the
+    mesh and wrap this kernel with parallel.ring.ring_attention, which is
+    exactly the regime ring attention exists for.
+
+    ``interpret`` defaults to True off-TPU so the identical kernel logic is
+    testable on CPU.
+    """
+    if not _HAVE_PALLAS:
+        raise NotImplementedError("pallas unavailable; use mha_reference")
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {sk}) must be multiples of blocks "
+            f"({block_q}, {block_k}); pad the sequence"
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, k_offset=k_offset
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)

@@ -1,0 +1,137 @@
+"""Ring attention: context/sequence parallelism over the device mesh.
+
+The reference's workload is fixed-shape image classification with no
+long-context mechanism anywhere (SURVEY.md section 5); this module is the
+framework's first-class long-context component.  Sequences longer than one
+chip's HBM/VMEM budget are sharded along the sequence axis over the mesh,
+and attention runs as a **ring**: each device computes partial attention of
+its local queries against the KV shard it currently holds, while
+``lax.ppermute`` rotates KV shards around the ring over ICI -- the permute
+for step t+1 overlaps the compute for step t, so with enough local work the
+collective is free (the blockwise/ring-attention schedule of Liu et al.).
+
+Partial attentions over KV shards merge with the log-sum-exp rule
+(ops.attention.combine_partials), which is exact -- ring attention returns
+bitwise-close results to full attention, it is not an approximation.
+
+Layout convention: (B, H, S, D) with S sharded over the mesh's ``data``
+axis (context parallelism reuses the batch axis: a long-sequence request is
+one "batch" spread over chips).  Composes with tensor parallelism by
+sharding H over ``model`` in the caller's sharding annotations.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_deep_learning_tpu.ops.attention import (
+    NEG_INF,
+    attend_block,
+    combine_partials,
+    finalize_partials,
+)
+from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
+
+
+@functools.lru_cache(maxsize=None)
+def build_ring_attention(
+    mesh: Mesh, *, causal: bool = False, axis_name: str = DATA_AXIS
+):
+    """Build the jitted ring-attention fn for a mesh (compile-once factory).
+
+    Cached per (mesh, causal, axis_name) so repeated calls reuse one jit
+    cache (same convention as parallel.dataparallel.build_sharded_forward).
+    """
+    n = mesh.shape[axis_name]
+    seq_spec = P(None, None, axis_name, None)
+    inner = shard_map(
+        functools.partial(_ring_shard, axis_name=axis_name, n=n, causal=causal),
+        mesh=mesh,
+        in_specs=(seq_spec,) * 3,
+        out_specs=seq_spec,
+    )
+    return jax.jit(inner)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = DATA_AXIS,
+):
+    """Exact attention with S sharded over ``axis_name``.  (B,H,S,D) in/out.
+
+    S must divide evenly by the axis size.  Inputs may be host arrays; they
+    are placed with the sequence sharding, and the output keeps it.
+    """
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(f"sequence {q.shape[2]} not divisible by ring size {n}")
+    seq_sharding = NamedSharding(mesh, P(None, None, axis_name, None))
+    q, k, v = (jax.device_put(x, seq_sharding) for x in (q, k, v))
+    return build_ring_attention(mesh, causal=causal, axis_name=axis_name)(q, k, v)
+
+
+def _ring_shard(q_blk, k_blk, v_blk, *, axis_name: str, n: int, causal: bool):
+    """Per-device body: local q vs rotating KV shards, merged partials."""
+    s_local = q_blk.shape[2]
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    partial_out = None
+    kv = (k_blk, v_blk)
+    for step in range(n):
+        # Launch the rotation for the NEXT step before computing on the
+        # current shard: XLA overlaps the ICI permute with the attend matmuls.
+        kv_next = jax.lax.ppermute(kv, axis_name, perm) if step < n - 1 else None
+
+        src = (rank - step) % n  # ring: who this KV shard belongs to
+        # Relative offset of this KV shard's global position vs our queries',
+        # feeding the causal mask: global_q >= global_k  <=>
+        # local_q >= local_k + (src - rank) * s_local.
+        k_offset = (src - rank) * s_local
+
+        if causal:
+            # KV shards strictly in our future are fully masked: skip their
+            # FLOPs entirely (half the ring work on average).
+            def compute(kv_pair):
+                return attend_block(
+                    q_blk, kv_pair[0], kv_pair[1], causal=True, k_offset=k_offset
+                )
+
+            def skip(kv_pair):
+                # Neutral partial: NEG_INF row-max makes combine_partials
+                # weight this contribution exp(NEG_INF - m_real) = 0.
+                # The varying zero keeps both cond branches typed as
+                # device-varying under shard_map (a plain constant would be
+                # replicated and the branch output types would disagree).
+                zero = jnp.sum(
+                    kv_pair[0][..., :1, :1].astype(jnp.float32) * 0.0, axis=(-2, -1)
+                )
+                acc = zero[..., None, None] + jnp.zeros(
+                    (*q_blk.shape[:3], v_blk.shape[-1]), jnp.float32
+                )
+                m = zero[..., None] + jnp.full(q_blk.shape[:3], NEG_INF, jnp.float32)
+                l = zero[..., None] + jnp.zeros(q_blk.shape[:3], jnp.float32)
+                return acc, m, l
+
+            p = jax.lax.cond(src <= rank, compute, skip, kv)
+        else:
+            p = attend_block(q_blk, kv[0], kv[1], k_offset=k_offset)
+
+        partial_out = p if partial_out is None else combine_partials(partial_out, p)
+        if kv_next is not None:
+            kv = kv_next
+
+    return finalize_partials(partial_out).astype(q_blk.dtype)

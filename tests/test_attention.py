@@ -1,0 +1,83 @@
+"""Flash-attention kernel + partial-merge algebra vs reference softmax."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from kubernetes_deep_learning_tpu.ops.attention import (
+    attend_block,
+    combine_partials,
+    finalize_partials,
+    flash_attention,
+    mha_reference,
+)
+
+
+def _rand_qkv(rng, b=2, h=2, s=256, d=64, dtype=np.float32):
+    shape = (b, h, s, d)
+    return tuple(rng.standard_normal(shape).astype(dtype) for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_f32(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _rand_qkv(rng)
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    want = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_close_to_f32_reference():
+    rng = np.random.default_rng(1)
+    q, k, v = _rand_qkv(rng)
+    got = flash_attention(
+        *(x.astype(jnp.bfloat16) for x in (q, k, v)), causal=False, interpret=True
+    )
+    want = mha_reference(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), atol=0.05, rtol=0.05
+    )
+
+
+def test_flash_rejects_ragged_seq():
+    rng = np.random.default_rng(2)
+    q, k, v = _rand_qkv(rng, s=100)
+    with pytest.raises(ValueError, match="multiples"):
+        flash_attention(q, k, v, interpret=True)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_partial_merge_equals_full(causal):
+    """Splitting KV into blocks and lse-merging partials is exact."""
+    rng = np.random.default_rng(3)
+    q, k, v = _rand_qkv(rng, s=128)
+    half = 64
+    p1 = attend_block(q, k[..., :half, :], v[..., :half, :], causal=causal, k_offset=0)
+    p2 = attend_block(q, k[..., half:, :], v[..., half:, :], causal=causal, k_offset=half)
+    got = finalize_partials(combine_partials(p1, p2))
+    want = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_fully_masked_rows_are_zero():
+    """causal + k_offset beyond the sequence: every key is in the future of
+    every query; empty softmax is defined as zeros, not mean(v)."""
+    rng = np.random.default_rng(5)
+    q, k, v = _rand_qkv(rng, s=128)
+    got = flash_attention(q, k, v, causal=True, k_offset=10_000, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.zeros_like(got))
+
+
+def test_fully_masked_block_is_neutral_in_merge():
+    """A KV block entirely in the causal future must not perturb the merge."""
+    rng = np.random.default_rng(4)
+    q, k, v = _rand_qkv(rng, s=64)
+    real = attend_block(q, k, v, causal=True, k_offset=0)
+    # Block whose every key is in the future of every query.
+    future = attend_block(q, k, v, causal=True, k_offset=10_000)
+    got = finalize_partials(combine_partials(real, future))
+    want = finalize_partials(real)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
