@@ -15,6 +15,37 @@ import os
 PLATFORM_ENV = "KDLT_PLATFORM"
 
 
+def force_virtual_cpu(n_devices: int) -> None:
+    """Re-point a process at an n-device virtual CPU mesh, even if a real
+    accelerator backend has already been initialized.
+
+    ``--xla_force_host_platform_device_count`` is parsed from $XLA_FLAGS once
+    per process by XLA's C++ flag parser, so it cannot help after any backend
+    init; instead this clears jax's backend caches and uses the
+    ``jax_num_cpu_devices`` config, which is read at (re-)creation of the CPU
+    client.  Used by the driver's ``dryrun_multichip`` entry when the host
+    sitecustomize latched a single-chip TPU plugin before our env took effect.
+    """
+    import jax
+
+    force_platform("cpu")
+    try:
+        import jax._src.xla_bridge as xb
+
+        xb._clear_backends()
+        if hasattr(xb.get_backend, "cache_clear"):
+            xb.get_backend.cache_clear()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception as e:  # pragma: no cover - depends on jax internals
+        raise RuntimeError(
+            "force_virtual_cpu could not rebuild the CPU backend with "
+            f"{n_devices} devices (jax {jax.__version__} internals changed?). "
+            "Start the process with JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} "
+            "before any jax import instead."
+        ) from e
+
+
 def force_platform(name: str | None) -> None:
     """name: "cpu", "tpu", ... or None => honor $KDLT_PLATFORM, else default."""
     if name is None:
