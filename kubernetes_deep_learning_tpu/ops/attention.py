@@ -91,18 +91,30 @@ def combine_partials(a, b):
 
 
 def finalize_partials(partial):
+    """(acc, m, l) -> normalized attention output.
+
+    Rows that attended nothing (l == 0, e.g. a flash partial over a fully
+    causal-masked shard) are defined as zeros rather than 0/0 NaN, matching
+    the fused kernel's empty-softmax convention.
+    """
     acc, _, l = partial
-    return acc / l[..., None]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return jnp.where((l == 0.0)[..., None], 0.0, acc / safe_l[..., None])
 
 
 # --- Pallas fused kernel ---------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset):
-    """One (1, block_q, d) query tile vs the full local KV, online softmax."""
+def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset):
+    """One (1, block_q, d) query tile vs the local KV, online softmax.
+
+    Returns the running ``(acc, m, l)`` carried state: unnormalized output,
+    row max, and normalizer, each f32 with m/l shaped (block_q, 1).
+    """
     q = q_ref[0].astype(jnp.float32)          # (block_q, d)
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
+    num_k = seq_k // block_k
     scale = 1.0 / math.sqrt(d)
     q_start = pl.program_id(1) * block_q
 
@@ -129,15 +141,46 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset):
         )
         return acc, m_new, l
 
+    if causal:
+        # KV blocks whose first key lies beyond this tile's last query are
+        # fully in the causal future: stop the stream at the diagonal block
+        # instead of computing-then-masking them (~2x FLOPs/bandwidth saved
+        # on average; the diagonal tile itself still masks elementwise).
+        hi = (q_start + block_q - k_offset + block_k - 1) // block_k
+        hi = jnp.clip(hi, 0, num_k)
+    else:
+        hi = num_k
+
     acc = jnp.zeros((block_q, d), jnp.float32)
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, seq_k // block_k, body, (acc, m, l))
+    return jax.lax.fori_loop(0, hi, body, (acc, m, l))
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset):
+    """Fused form: normalize in-kernel, write the attention output tile."""
+    acc, m, l = _flash_body(
+        q_ref, k_ref, v_ref, block_k=block_k, causal=causal, k_offset=k_offset
+    )
     # A row masked across EVERY key (causal with k_offset pushing the whole
     # block into the future) ends with m still at NEG_INF and p=exp(0)=1
     # everywhere, i.e. acc/l = mean(v); define empty-softmax as zeros instead.
     masked = m <= NEG_INF * 0.5
-    o_ref[0] = jnp.where(masked, 0.0, acc / l).astype(o_ref.dtype)
+    o_ref[0] = jnp.where(masked, 0.0, acc / jnp.where(masked, 1.0, l)).astype(
+        o_ref.dtype
+    )
+
+
+def _flash_kernel_partials(
+    q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *, block_k, causal, k_offset
+):
+    """Partial form: write raw (acc, m, l) for cross-shard lse merging."""
+    acc, m, l = _flash_body(
+        q_ref, k_ref, v_ref, block_k=block_k, causal=causal, k_offset=k_offset
+    )
+    acc_ref[0] = acc
+    m_ref[0] = m  # (block_q, 1): trailing singleton keeps Mosaic tiling legal
+    l_ref[0] = l
 
 
 try:  # pallas needs a recent jaxlib; keep the module importable without it
@@ -159,6 +202,7 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    return_partials: bool = False,
 ):
     """Fused flash attention.  q, k, v: (B, H, S, D) -> (B, H, S, D).
 
@@ -167,6 +211,12 @@ def flash_attention(
     S=8192 at D=128 bf16 is 2 MB/tensor.  Longer sequences shard S over the
     mesh and wrap this kernel with parallel.ring.ring_attention, which is
     exactly the regime ring attention exists for.
+
+    With ``return_partials=True`` the kernel skips in-kernel normalization
+    and returns ``(acc, m, l)`` in ``attend_block``'s layout (acc f32
+    (B,H,S,D); m, l f32 (B,H,S)) so ring attention can lse-merge partial
+    attentions over KV shards while keeping O(S*D) memory -- attend_block's
+    einsum would materialize the (S_local, S_local) score matrix per shard.
 
     ``interpret`` defaults to True off-TPU so the identical kernel logic is
     testable on CPU.
@@ -187,19 +237,57 @@ def flash_attention(
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
 
+    # Inside shard_map, outputs must declare which mesh axes they vary over
+    # (check_vma); propagate the query's vma so the kernel composes with
+    # parallel.ring.  Outside shard_map this is the empty set / None.
+    vma = getattr(jax.typeof(qf), "vma", None)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
+    ]
+    grid = (b * h, sq // block_q)
+
+    if return_partials:
+        kernel = functools.partial(
+            _flash_kernel_partials, block_k=block_k, causal=causal, k_offset=k_offset
+        )
+        # (B*H, S, 1) with trailing singleton: Mosaic requires the last two
+        # block dims be (8k, 128k)-divisible or equal to the array dims; a
+        # plain (1, block_q) row block violates that on TPU.
+        row_spec = pl.BlockSpec((1, block_q, 1), lambda g, i: (g, i, 0))
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
+                row_spec,
+                row_spec,
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32, vma=vma),
+                jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32, vma=vma),
+                jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32, vma=vma),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf)
+        return (
+            acc.reshape(b, h, sq, d),
+            m.reshape(b, h, sq),
+            l.reshape(b, h, sq),
+        )
+
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, k_offset=k_offset
     )
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda g, i: (g, 0, 0)),
-        ],
+        grid=grid,
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)

@@ -37,26 +37,62 @@ from kubernetes_deep_learning_tpu.ops.attention import (
     attend_block,
     combine_partials,
     finalize_partials,
+    flash_attention,
 )
 from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
 
 
+def _flash_block(s_local: int) -> int | None:
+    """Largest MXU-friendly block size dividing the local sequence, if any."""
+    for b in (128, 64, 32, 16, 8):
+        if s_local % b == 0:
+            return b
+    return None
+
+
+# flash_attention keeps the whole local K and V resident in VMEM (~16 MB/core
+# shared with the q tile, accumulator, and double-buffering); beyond roughly
+# half of it for KV, Mosaic fails to allocate.  Auto mode falls back to the
+# einsum path above this, so pre-existing large-shard calls keep working.
+_FLASH_KV_VMEM_BUDGET = 8 * 2**20
+
+
 @functools.lru_cache(maxsize=None)
 def build_ring_attention(
-    mesh: Mesh, *, causal: bool = False, axis_name: str = DATA_AXIS
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = DATA_AXIS,
+    use_flash: bool | None = None,
 ):
     """Build the jitted ring-attention fn for a mesh (compile-once factory).
 
-    Cached per (mesh, causal, axis_name) so repeated calls reuse one jit
-    cache (same convention as parallel.dataparallel.build_sharded_forward).
+    Cached per (mesh, causal, axis_name, use_flash) so repeated calls reuse
+    one jit cache (same convention as parallel.dataparallel.
+    build_sharded_forward).
+
+    ``use_flash`` selects the per-shard attend: the fused Pallas kernel in
+    partial-output mode (O(S_local * D) memory -- required for long
+    contexts) vs the reference einsum path (materializes the
+    (S_local, S_local) score matrix; fine for short shards, used as the
+    fallback when S_local does not tile).  None = auto by shape.
     """
     n = mesh.shape[axis_name]
     seq_spec = P(None, None, axis_name, None)
     inner = shard_map(
-        functools.partial(_ring_shard, axis_name=axis_name, n=n, causal=causal),
+        functools.partial(
+            _ring_shard, axis_name=axis_name, n=n, causal=causal, use_flash=use_flash
+        ),
         mesh=mesh,
         in_specs=(seq_spec,) * 3,
         out_specs=seq_spec,
+        # jax 0.9's pallas interpreter (CPU tests) loses vma tracking on its
+        # internal dynamic_slice when a pallas_call sits under shard_map; jax
+        # itself prescribes check_vma=False as the workaround.  Keep the
+        # trace-time vma validation on the real-TPU path (non-interpret);
+        # off-TPU, sharding correctness is still covered by test_ring_output_
+        # keeps_sequence_sharding and the vs-reference exactness tests.
+        check_vma=all(d.platform == "tpu" for d in mesh.devices.flat),
     )
     return jax.jit(inner)
 
@@ -69,6 +105,7 @@ def ring_attention(
     *,
     causal: bool = False,
     axis_name: str = DATA_AXIS,
+    use_flash: bool | None = None,
 ):
     """Exact attention with S sharded over ``axis_name``.  (B,H,S,D) in/out.
 
@@ -80,14 +117,46 @@ def ring_attention(
         raise ValueError(f"sequence {q.shape[2]} not divisible by ring size {n}")
     seq_sharding = NamedSharding(mesh, P(None, None, axis_name, None))
     q, k, v = (jax.device_put(x, seq_sharding) for x in (q, k, v))
-    return build_ring_attention(mesh, causal=causal, axis_name=axis_name)(q, k, v)
+    return build_ring_attention(
+        mesh, causal=causal, axis_name=axis_name, use_flash=use_flash
+    )(q, k, v)
 
 
-def _ring_shard(q_blk, k_blk, v_blk, *, axis_name: str, n: int, causal: bool):
+def _ring_shard(
+    q_blk, k_blk, v_blk, *, axis_name: str, n: int, causal: bool, use_flash: bool | None
+):
     """Per-device body: local q vs rotating KV shards, merged partials."""
     s_local = q_blk.shape[2]
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    block = _flash_block(s_local)
+    kv_bytes = 2 * s_local * k_blk.shape[-1] * jnp.dtype(k_blk.dtype).itemsize
+    if use_flash is None:
+        use_flash = block is not None and kv_bytes <= _FLASH_KV_VMEM_BUDGET
+    elif use_flash and block is None:
+        raise ValueError(
+            f"use_flash=True but local sequence {s_local} has no MXU tiling"
+        )
+
+    def attend(kv_pair, *, causal: bool, k_offset: int):
+        # The shard's global offset only matters under the causal mask, and
+        # there it is static per ring step (see the step loop): the Pallas
+        # kernel therefore never needs a device-varying offset.
+        if use_flash:
+            return flash_attention(
+                q_blk,
+                kv_pair[0],
+                kv_pair[1],
+                causal=causal,
+                k_offset=k_offset,
+                block_q=block,
+                block_k=block,
+                return_partials=True,
+            )
+        return attend_block(
+            q_blk, kv_pair[0], kv_pair[1], causal=causal, k_offset=k_offset
+        )
 
     partial_out = None
     kv = (k_blk, v_blk)
@@ -96,19 +165,21 @@ def _ring_shard(q_blk, k_blk, v_blk, *, axis_name: str, n: int, causal: bool):
         # current shard: XLA overlaps the ICI permute with the attend matmuls.
         kv_next = jax.lax.ppermute(kv, axis_name, perm) if step < n - 1 else None
 
-        src = (rank - step) % n  # ring: who this KV shard belongs to
-        # Relative offset of this KV shard's global position vs our queries',
-        # feeding the causal mask: global_q >= global_k  <=>
-        # local_q >= local_k + (src - rank) * s_local.
-        k_offset = (src - rank) * s_local
+        # At step t this device holds the KV shard of src = (rank - t) % n.
+        # Under the causal mask only the src/rank ORDER matters, and it is
+        # static given the step: step 0 is our own shard (the causal
+        # diagonal, offset 0); for step > 0 the shard is either strictly in
+        # our past (src < rank: every key visible, no mask needed) or
+        # strictly in our future (src > rank: fully masked, skip the FLOPs
+        # entirely -- half the ring work on average).
+        if not causal:
+            p = attend(kv, causal=False, k_offset=0)
+        elif step == 0:
+            p = attend(kv, causal=True, k_offset=0)
+        else:
 
-        if causal:
-            # KV shards strictly in our future are fully masked: skip their
-            # FLOPs entirely (half the ring work on average).
             def compute(kv_pair):
-                return attend_block(
-                    q_blk, kv_pair[0], kv_pair[1], causal=True, k_offset=k_offset
-                )
+                return attend(kv_pair, causal=False, k_offset=0)
 
             def skip(kv_pair):
                 # Neutral partial: NEG_INF row-max makes combine_partials
@@ -126,9 +197,7 @@ def _ring_shard(q_blk, k_blk, v_blk, *, axis_name: str, n: int, causal: bool):
                 l = zero[..., None] + jnp.zeros(q_blk.shape[:3], jnp.float32)
                 return acc, m, l
 
-            p = jax.lax.cond(src <= rank, compute, skip, kv)
-        else:
-            p = attend_block(q_blk, kv[0], kv[1], k_offset=k_offset)
+            p = jax.lax.cond(rank >= step, compute, skip, kv)
 
         partial_out = p if partial_out is None else combine_partials(partial_out, p)
         if kv_next is not None:

@@ -71,6 +71,81 @@ def test_flash_fully_masked_rows_are_zero():
     np.testing.assert_array_equal(np.asarray(got), np.zeros_like(got))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_partials_match_attend_block(causal):
+    """Partial-output kernel returns the same (acc, m, l) algebra as the
+    reference einsum path, so ring attention can swap one for the other."""
+    rng = np.random.default_rng(6)
+    q, k, v = _rand_qkv(rng, s=128)
+    ref = attend_block(q, k, v, causal=causal, k_offset=0)
+    got = flash_attention(
+        q, k, v, causal=causal, block_q=64, block_k=64,
+        interpret=True, return_partials=True,
+    )
+    np.testing.assert_allclose(np.asarray(got[1]), np.asarray(ref[1]), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(finalize_partials(got)),
+        np.asarray(finalize_partials(ref)),
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+def test_flash_partials_merge_across_kv_shards():
+    """lse-merging two flash partials over split KV equals full attention --
+    the exact composition ring attention performs."""
+    rng = np.random.default_rng(7)
+    q, k, v = _rand_qkv(rng, s=128)
+    half = 64
+    p1 = flash_attention(
+        q, k[..., :half, :], v[..., :half, :], causal=True,
+        block_q=64, block_k=64, interpret=True, return_partials=True,
+    )
+    # Remote "past" shard in ring order: fully visible, no mask needed.
+    p2 = flash_attention(
+        q, k[..., half:, :], v[..., half:, :], causal=True, k_offset=half,
+        block_q=64, block_k=64, interpret=True, return_partials=True,
+    )
+    got = finalize_partials(combine_partials(p1, p2))
+    want = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_causal_negative_offset_matches_reference():
+    """KV shard from the past (ring attention): every row partially visible,
+    so flash and plain softmax agree everywhere."""
+    rng = np.random.default_rng(8)
+    q, k, v = _rand_qkv(rng, s=128)
+    got = flash_attention(q, k, v, causal=True, k_offset=-64, interpret=True)
+    want = mha_reference(q, k, v, causal=True, k_offset=-64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_causal_positive_offset_bounded_stream():
+    """KV shard shifted into the future: visible rows must stay exact under
+    the diagonal-bounded KV stream; fully-masked rows are defined as zero."""
+    rng = np.random.default_rng(9)
+    q, k, v = _rand_qkv(rng, s=128)
+    got = np.asarray(
+        flash_attention(q, k, v, causal=True, k_offset=64, interpret=True)
+    )
+    want = np.asarray(mha_reference(q, k, v, causal=True, k_offset=64))
+    # Rows 0..63 see no keys (key j sits at global position j+64): zeros.
+    np.testing.assert_array_equal(got[..., :64, :], np.zeros_like(got[..., :64, :]))
+    np.testing.assert_allclose(got[..., 64:, :], want[..., 64:, :], atol=2e-5, rtol=2e-5)
+
+
+def test_finalize_zero_l_rows_are_zero_not_nan():
+    """A flash partial over a fully-masked shard carries l=0; finalizing it
+    directly must yield zeros (the empty-softmax convention), not 0/0."""
+    rng = np.random.default_rng(10)
+    q, k, v = _rand_qkv(rng, s=128)
+    p = flash_attention(
+        q, k, v, causal=True, k_offset=10_000, interpret=True, return_partials=True
+    )
+    out = np.asarray(finalize_partials(p))
+    np.testing.assert_array_equal(out, np.zeros_like(out))
+
+
 def test_fully_masked_block_is_neutral_in_merge():
     """A KV block entirely in the causal future must not perturb the merge."""
     rng = np.random.default_rng(4)

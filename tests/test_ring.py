@@ -25,13 +25,27 @@ def _rand_qkv(rng, b=1, h=2, s=128, d=32):
     return tuple(rng.standard_normal(shape).astype(np.float32) for _ in range(3))
 
 
+@pytest.mark.parametrize("use_flash", [False, True, None])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_matches_full_attention(mesh8, causal):
+def test_ring_matches_full_attention(mesh8, causal, use_flash):
     rng = np.random.default_rng(0)
     q, k, v = _rand_qkv(rng)
-    got = ring_attention(q, k, v, mesh8, causal=causal)
+    got = ring_attention(q, k, v, mesh8, causal=causal, use_flash=use_flash)
     want = mha_reference(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5, rtol=3e-5)
+
+
+def test_ring_falls_back_when_shard_has_no_tiling():
+    """s_local = 9 has no MXU block size: auto mode must use the einsum
+    path instead of failing, and explicit use_flash=True must raise."""
+    mesh2 = make_mesh(2, model_parallel=1)
+    rng = np.random.default_rng(4)
+    q, k, v = _rand_qkv(rng, s=18)
+    got = ring_attention(q, k, v, mesh2, causal=True)
+    want = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5, rtol=3e-5)
+    with pytest.raises(ValueError, match="no MXU tiling"):
+        ring_attention(q, k, v, mesh2, use_flash=True)
 
 
 def test_ring_output_keeps_sequence_sharding(mesh8):
