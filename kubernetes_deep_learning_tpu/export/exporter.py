@@ -91,10 +91,27 @@ def export_model(
         version = 1 if latest is None else latest + 1
     if params_dtype is not None:
         variables = cast_params(variables, params_dtype)
-    exported_bytes = trace_forward(spec, variables, dtype=dtype, platforms=platforms)
+    exported_bytes: bytes | dict[str, bytes]
+    try:
+        exported_bytes = trace_forward(spec, variables, dtype=dtype, platforms=platforms)
+        layout = "single"
+    except ValueError:
+        # Forwards with platform-gated code (jax.lax.platform_dependent, e.g.
+        # the ViT's Pallas attention) cannot co-lower into one multi-platform
+        # module -- every branch is kept and lowered for every platform, so
+        # the Mosaic kernel hits the CPU rule.  Trace one single-platform
+        # module each instead; the loader picks by runtime platform.
+        if len(platforms) <= 1:
+            raise
+        exported_bytes = {
+            p: trace_forward(spec, variables, dtype=dtype, platforms=(p,))
+            for p in platforms
+        }
+        layout = "per-platform"
     metadata = {
         "jax_version": jax.__version__,
         "platforms": list(platforms),
+        "module_layout": layout,
         "compute_dtype": jnp.dtype(dtype).name,
         "params_dtype": jnp.dtype(params_dtype).name if params_dtype is not None else None,
         "framework_version": __import__("kubernetes_deep_learning_tpu").__version__,

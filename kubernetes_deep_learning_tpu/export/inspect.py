@@ -43,6 +43,12 @@ def describe(directory: str) -> str:
         exp = a.exported
         lines.append(f"  stablehlo:     {len(a.exported_bytes):,} bytes, platforms={exp.platforms}")
         lines.append(f"  calling conv:  v{exp.calling_convention_version}, batch dim symbolic")
+    for platform, blob in sorted(a.platform_modules.items()):
+        exp = a.exported_for(platform)
+        lines.append(
+            f"  stablehlo[{platform}]: {len(blob):,} bytes, "
+            f"calling conv v{exp.calling_convention_version}, batch dim symbolic"
+        )
     for k, v in sorted(a.metadata.items()):
         lines.append(f"  meta.{k}: {v}")
     return "\n".join(lines)

@@ -30,7 +30,17 @@ def create_model(spec: ModelSpec, dtype: Any = None):
         from kubernetes_deep_learning_tpu.models.efficientnet import EfficientNetB3
 
         return EfficientNetB3(spec.num_classes, dtype=dtype)
+    if spec.family in _vit_families():
+        from kubernetes_deep_learning_tpu.models.vit import VIT_CONFIGS, ViT
+
+        return ViT(spec.num_classes, config=VIT_CONFIGS[spec.family], dtype=dtype)
     raise KeyError(f"unknown model family {spec.family!r}")
+
+
+def _vit_families() -> tuple[str, ...]:
+    from kubernetes_deep_learning_tpu.models.vit import VIT_CONFIGS
+
+    return tuple(VIT_CONFIGS)
 
 
 def init_variables(spec: ModelSpec, seed: int = 0, dtype: Any = None):

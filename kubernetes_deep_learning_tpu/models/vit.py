@@ -1,0 +1,172 @@
+"""Vision Transformer in flax.linen, attention via the in-tree Pallas kernel.
+
+The reference zoo is CNN-only (Xception/ResNet/EfficientNet, all served
+through the same gateway contract, reference guide.md:220-231).  This family
+extends the zoo with a transformer classifier so the framework's attention
+stack -- ops.attention's fused flash kernel and, at long sequence lengths,
+parallel.ring's context parallelism -- has a first-class consumer in the
+serving path rather than existing as free-floating ops.
+
+TPU-first choices:
+
+- **Mean-pool instead of a cls token.**  Token count stays the patch grid
+  (H/p * W/p), a multiple of the flash kernel's 128-wide MXU tiles for the
+  registered input sizes; a cls token would make S=197-style primes and force
+  either padding or the unfused path.
+- **Fused attention at inference, gated per lowering platform.**
+  ``train=False`` lowers attention through ops.attention.flash_attention
+  (online softmax, no (S,S) matrix in HBM) in the TPU lowering, and through
+  the einsum reference in CPU lowerings of the same traced module
+  (jax.lax.platform_dependent -- the exporter emits one module for both).
+  The training path always uses the einsum reference: the Pallas kernel
+  defines no VJP, and at these sequence lengths the materialized score
+  matrix is cheap -- XLA fuses mask/softmax into the matmuls.
+- Params stay float32; compute dtype is a module arg (bf16 for serving),
+  with LayerNorm always computed in f32 for stability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubernetes_deep_learning_tpu.ops import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    patch: int
+    width: int
+    depth: int
+    heads: int
+    mlp_ratio: int = 4
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.heads
+
+
+# Family registry: ModelSpec.family -> architecture hyperparameters.
+VIT_CONFIGS: dict[str, ViTConfig] = {
+    "vit-s16": ViTConfig(patch=16, width=384, depth=12, heads=6),
+    "vit-b16": ViTConfig(patch=16, width=768, depth=12, heads=12),
+    # Test-scale config: small enough for CPU pallas-interpret runs.
+    "vit-tiny": ViTConfig(patch=8, width=64, depth=2, heads=2),
+}
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention over (B, S, C) tokens."""
+
+    heads: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, c = x.shape
+        head_dim = c // self.heads
+        proj = lambda name: nn.DenseGeneral(
+            (self.heads, head_dim), dtype=self.dtype, name=name
+        )
+        # (B, S, H, D) -> (B, H, S, D), the attention-kernel layout.
+        q = proj("query")(x).transpose(0, 2, 1, 3)
+        k = proj("key")(x).transpose(0, 2, 1, 3)
+        v = proj("value")(x).transpose(0, 2, 1, 3)
+
+        block = attention.pick_block(s)
+        if train or block is None or not attention._HAVE_PALLAS:
+            o = attention.mha_reference(q, k, v)
+        else:
+            # Resolve the kernel choice at LOWERING time, not trace time: the
+            # exporter traces one module for both cpu and tpu platforms, so a
+            # trace-time jax.devices() check would bake the wrong mode into
+            # one of them (interpreted Pallas on CPU serving, or a
+            # non-interpretable kernel in the CPU lowering).
+            import functools
+
+            import jax
+
+            o = jax.lax.platform_dependent(
+                q,
+                k,
+                v,
+                tpu=functools.partial(
+                    attention.flash_attention,
+                    block_q=block,
+                    block_k=block,
+                    interpret=False,
+                ),
+                default=attention.mha_reference,
+            )
+        o = o.transpose(0, 2, 1, 3)  # back to (B, S, H, D)
+        return nn.DenseGeneral(
+            c, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(o)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LayerNorm residual block: MHA then GELU MLP."""
+
+    heads: int
+    mlp_ratio: int
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = x.shape[-1]
+        # LayerNorm in f32 (param_dtype default); cast back for the matmuls.
+        y = nn.LayerNorm(name="ln_attn")(x.astype(jnp.float32)).astype(x.dtype)
+        x = x + SelfAttention(self.heads, dtype=self.dtype, name="attn")(
+            y, train=train
+        )
+        y = nn.LayerNorm(name="ln_mlp")(x.astype(jnp.float32)).astype(x.dtype)
+        y = nn.Dense(c * self.mlp_ratio, dtype=self.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c, dtype=self.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int
+    config: ViTConfig
+    dtype: Any = None  # compute dtype; params stay float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        h, w = x.shape[1], x.shape[2]
+        if h % cfg.patch or w % cfg.patch:
+            raise ValueError(
+                f"input {h}x{w} not divisible by patch size {cfg.patch}"
+            )
+        # Patchify as a strided conv: one MXU matmul over p*p*3 -> width.
+        x = nn.Conv(
+            cfg.width,
+            (cfg.patch, cfg.patch),
+            strides=(cfg.patch, cfg.patch),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        b = x.shape[0]
+        seq = x.shape[1] * x.shape[2]
+        x = x.reshape(b, seq, cfg.width)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, seq, cfg.width),
+            jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+
+        for i in range(cfg.depth):
+            x = TransformerBlock(
+                cfg.heads, cfg.mlp_ratio, dtype=self.dtype, name=f"block_{i}"
+            )(x, train=train)
+
+        x = nn.LayerNorm(name="ln_final")(x.astype(jnp.float32))
+        x = x.mean(axis=1)  # token mean-pool (no cls token, see module doc)
+        return nn.Dense(self.num_classes, name="head")(x)

@@ -131,3 +131,18 @@ EFFICIENTNET_B3_IMAGENET = register_spec(
         description="EfficientNet-B3 ImageNet classifier",
     )
 )
+
+# Transformer classifier: the serving-path consumer of the in-tree flash
+# attention kernel (ops.attention) -- 256x256/16 gives a 256-token sequence,
+# an exact multiple of the kernel's 128-wide MXU tiles.  Inception-style
+# [-1, 1] scaling per the original ViT recipe.
+VIT_B16_IMAGENET = register_spec(
+    ModelSpec(
+        name="vit-b16-imagenet",
+        family="vit-b16",
+        input_shape=(256, 256, 3),
+        labels=_IMAGENET_LABELS,
+        preprocessing="tf",
+        description="ViT-B/16 ImageNet classifier (Pallas flash attention)",
+    )
+)

@@ -40,6 +40,19 @@ def _causal_mask(q_offset: int, k_offset, block_q: int, block_k: int):
     return rows >= cols
 
 
+def pick_block(seq: int) -> int | None:
+    """Largest MXU-friendly flash block (<=128, 8-aligned) dividing ``seq``.
+
+    None means no legal tiling exists and callers must use the einsum path.
+    Single source of the kernel's tiling rule -- consumed by models.vit and
+    parallel.ring.
+    """
+    for block in (128, 64, 32, 16, 8):
+        if seq % block == 0:
+            return block
+    return None
+
+
 def mha_reference(q, k, v, *, causal: bool = False, k_offset: int = 0):
     """Plain softmax attention, (..., S, D) layout.  Ground truth for tests.
 

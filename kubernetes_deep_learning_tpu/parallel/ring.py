@@ -38,16 +38,9 @@ from kubernetes_deep_learning_tpu.ops.attention import (
     combine_partials,
     finalize_partials,
     flash_attention,
+    pick_block as _flash_block,
 )
 from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
-
-
-def _flash_block(s_local: int) -> int | None:
-    """Largest MXU-friendly block size dividing the local sequence, if any."""
-    for b in (128, 64, 32, 16, 8):
-        if s_local % b == 0:
-            return b
-    return None
 
 
 # flash_attention keeps the whole local K and V resident in VMEM (~16 MB/core

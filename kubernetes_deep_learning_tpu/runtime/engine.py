@@ -58,8 +58,9 @@ class InferenceEngine:
         # Compute dtype recorded at export time; the f32 debug path must use
         # the same dtype or it would disagree numerically with the wire path.
         self._compute_dtype = artifact.metadata.get("compute_dtype", "bfloat16")
-        if use_exported and artifact.exported_bytes is not None:
-            self._jitted = jax.jit(artifact.exported.call)
+        platform = self._device.platform
+        if use_exported and artifact.module_bytes_for(platform) is not None:
+            self._jitted = jax.jit(artifact.exported_for(platform).call)
             # The exported module is traced for the uint8 wire path only;
             # float32 "pre-normalized" input (protocol.decode_predict_request's
             # JSON debug path) runs through the in-tree forward instead,

@@ -91,7 +91,8 @@ def build_train_step(
         )
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
         acc = (logits.argmax(-1) == labels).mean()
-        return loss, (updates["batch_stats"], acc)
+        # BN-free families (vit) mutate no batch_stats; keep the empty dict.
+        return loss, (updates.get("batch_stats", batch_stats), acc)
 
     def train_step(state: TrainState, images, labels):
         (loss, (new_stats, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
