@@ -63,12 +63,13 @@ class ModelArtifact:
 
     def exported_for(self, platform: str):
         """Deserialized jax.export.Exported usable on ``platform`` (lazy)."""
-        blob = self.module_bytes_for(platform)
+        if self.exported_bytes is not None:
+            return self.exported  # multi-platform module: one shared deserialize
+        blob = self.platform_modules.get(platform)
         if blob is None:
             raise ValueError(
                 f"artifact at {self.path!r} has no StableHLO module for "
-                f"{platform!r} (available: "
-                f"{'multi-platform' if self.exported_bytes else sorted(self.platform_modules)})"
+                f"{platform!r} (available: {sorted(self.platform_modules)})"
             )
         if platform not in self._exported_cache:
             from jax import export as jax_export

@@ -95,13 +95,15 @@ def export_model(
     try:
         exported_bytes = trace_forward(spec, variables, dtype=dtype, platforms=platforms)
         layout = "single"
-    except ValueError:
+    except ValueError as e:
         # Forwards with platform-gated code (jax.lax.platform_dependent, e.g.
         # the ViT's Pallas attention) cannot co-lower into one multi-platform
         # module -- every branch is kept and lowered for every platform, so
         # the Mosaic kernel hits the CPU rule.  Trace one single-platform
-        # module each instead; the loader picks by runtime platform.
-        if len(platforms) <= 1:
+        # module each instead; the loader picks by runtime platform.  Only
+        # that lowering failure triggers the fallback: any other ValueError
+        # (bad spec, shape mismatch) would just re-trace into the same error.
+        if len(platforms) <= 1 or "interpret mode" not in str(e):
             raise
         exported_bytes = {
             p: trace_forward(spec, variables, dtype=dtype, platforms=(p,))

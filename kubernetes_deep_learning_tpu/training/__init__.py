@@ -3,5 +3,18 @@ from kubernetes_deep_learning_tpu.training.trainer import (
     build_train_step,
     create_train_state,
 )
+from kubernetes_deep_learning_tpu.training.checkpoint import Checkpointer, abstract_like
+from kubernetes_deep_learning_tpu.training.data import PrefetchIterator, synthetic_batches
+from kubernetes_deep_learning_tpu.training.loop import fit, fit_and_export
 
-__all__ = ["TrainState", "build_train_step", "create_train_state"]
+__all__ = [
+    "Checkpointer",
+    "PrefetchIterator",
+    "TrainState",
+    "abstract_like",
+    "build_train_step",
+    "create_train_state",
+    "fit",
+    "fit_and_export",
+    "synthetic_batches",
+]

@@ -1,0 +1,97 @@
+"""Training checkpoint/resume (orbax) -- the subsystem the reference lacks.
+
+The reference has no training, so its only "checkpoint" mechanism is the
+immutable versioned SavedModel baked into the serving image (reference
+tf-serving.dockerfile:5; SURVEY.md section 5 "checkpoint/resume").  The
+serving side of that story lives in export/artifact.py (versioned artifact
+dirs, hot-reload).  This module covers the training side: periodic snapshots
+of the full TrainState (params, batch stats, optimizer state, step) with
+retention, and restore-on-boot so an interrupted fine-tuning run resumes at
+the last saved step.
+
+Orbax is the TPU-native choice here: it writes sharded jax.Arrays as
+distributed tensorstore shards (each host saves only its addressable shards
+-- no gather to host 0, which matters for model-parallel params), and
+restores them with the shardings of the abstract target, so a checkpoint
+written on one mesh can be reloaded onto another.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class Checkpointer:
+    """Periodic TrainState snapshots with retention, via orbax.
+
+    Saves are asynchronous (orbax's default): the device->host copy blocks
+    only briefly and serialization proceeds in the background.  ``wait()``
+    (or close/exit) joins outstanding writes; ``save`` of step N+1 joins the
+    write of step N automatically.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, state: Any, force: bool = False) -> bool:
+        """Snapshot ``state`` at its own step counter."""
+        return self._mngr.save(
+            int(jax.device_get(state.step)),
+            args=self._ocp.args.StandardSave(state),
+            force=force,
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """Restore the latest (or a specific) snapshot.
+
+        ``abstract_state`` is a TrainState of jax.ShapeDtypeStructs (see
+        ``abstract_like``) carrying the target shardings: orbax lays the
+        restored arrays out directly as specified, so restoring onto a
+        different mesh than the one that saved is just a different abstract
+        target.  Returns None when the directory has no checkpoint yet.
+        """
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None:
+            return None
+        return self._mngr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract_state)
+        )
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()
+
+
+def abstract_like(state: Any) -> Any:
+    """TrainState of ShapeDtypeStructs (with shardings) mirroring ``state``.
+
+    The cheap way to build a restore target from the freshly-initialized
+    state the training loop creates anyway.
+    """
+
+    def to_abstract(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "shape") and hasattr(x, "dtype"):  # np arrays/scalars
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x  # python scalars etc. pass through as concrete targets
+
+    return jax.tree.map(to_abstract, state)

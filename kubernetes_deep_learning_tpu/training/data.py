@@ -1,0 +1,123 @@
+"""Input pipeline: background host->device prefetch for the training loop.
+
+The reference has no input pipeline (no training; its serving input path is
+one HTTP fetch per request, reference model_server.py:53).  For training the
+classic TPU bottleneck is the host: if device_put and the forward pass run
+in the same Python loop, the accelerator idles while numpy assembles the
+next batch.  This stages batches onto the device from a daemon thread ahead
+of consumption -- with jax's async dispatch the train step for batch N
+overlaps host prep + transfer of batch N+1/N+2.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+
+
+class PrefetchIterator:
+    """Wrap a host batch iterator; yield device-resident pytrees.
+
+    ``sharding`` (e.g. parallel.mesh.batch_sharding(mesh)) spreads each
+    batch over the mesh's data axis at transfer time, so the train step's
+    in_shardings see already-placed arrays and insert no reshards.  Errors
+    raised by the host iterator surface at the consuming ``next()`` call.
+    """
+
+    _DONE = object()
+
+    def __init__(self, source: Iterable, sharding=None, depth: int = 2):
+        self._sharding = sharding
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._err: BaseException | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(iter(source),), name="kdlt-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, batch):
+        if self._sharding is not None:
+            return jax.tree.map(lambda a: jax.device_put(a, self._sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    def _enqueue(self, item) -> bool:
+        """put() that aborts on close(): with a bounded queue and an endless
+        source, a plain blocking put would pin this thread (and depth+1
+        device batches) forever once the consumer walks away."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it: Iterator) -> None:
+        try:
+            for batch in it:
+                if self._stop.is_set() or not self._enqueue(self._put(batch)):
+                    return
+        except BaseException as e:  # surface on the consumer side
+            self._err = e
+        finally:
+            self._enqueue(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release staged batches.  Idempotent; the
+        consumer (training.loop.fit) must call this when it stops early."""
+        self._stop.set()
+        while True:  # unblock a producer waiting on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def synthetic_batches(
+    spec: ModelSpec, batch: int, steps: int | None = None, seed: int = 0
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Endless (or ``steps``-bounded) random (uint8 images, int32 labels).
+
+    The test/bench stand-in for a real dataset; spec-shaped so it plugs
+    straight into build_train_step.
+    """
+    rng = np.random.default_rng(seed)
+    n = 0
+    while steps is None or n < steps:
+        images = rng.integers(0, 256, size=(batch, *spec.input_shape), dtype=np.uint8)
+        labels = rng.integers(0, spec.num_classes, size=(batch,), dtype=np.int32)
+        yield images, labels
+        n += 1
+
+
+def map_batches(
+    source: Iterable, fn: Callable[[Any], Any]
+) -> Iterator[Any]:
+    """Lazy per-batch transform (augmentation hook) on the host side."""
+    for batch in source:
+        yield fn(batch)

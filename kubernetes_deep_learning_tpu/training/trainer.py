@@ -43,6 +43,29 @@ jax.tree_util.register_dataclass(
 )
 
 
+def _replicate_unsharded(tree: Any, mesh: Mesh) -> Any:
+    """Commit single-device leaves (optimizer scalars like adam's count, the
+    step counter) to a mesh-replicated sharding.
+
+    Freshly-created scalars are uncommitted, so jit places them to match the
+    mesh-sharded params -- but a checkpoint restore returns them *committed*
+    to one device (orbax restores exactly the shardings of the abstract
+    target), and jit rejects mixing committed single-device and committed
+    mesh-wide arguments.  Making the initial state mesh-consistent means
+    abstract_like targets are too, so restored states are as well.
+    """
+    from jax.sharding import SingleDeviceSharding
+
+    replicated = NamedSharding(mesh, P())
+
+    def put(x):
+        if isinstance(x, jax.Array) and isinstance(x.sharding, SingleDeviceSharding):
+            return jax.device_put(x, replicated)
+        return x
+
+    return jax.tree.map(put, tree)
+
+
 def create_train_state(
     spec: ModelSpec,
     tx: optax.GradientTransformation,
@@ -63,7 +86,11 @@ def create_train_state(
         )
         params, batch_stats = sharded["params"], sharded["batch_stats"]
     opt_state = tx.init(params)
-    return TrainState(jnp.zeros((), jnp.int32), params, batch_stats, opt_state)
+    step = jnp.zeros((), jnp.int32)
+    if mesh is not None:
+        opt_state = _replicate_unsharded(opt_state, mesh)
+        step = jax.device_put(step, NamedSharding(mesh, P()))
+    return TrainState(step, params, batch_stats, opt_state)
 
 
 def build_train_step(

@@ -1,0 +1,173 @@
+import jax
+import numpy as np
+import optax
+import pytest
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.training import (
+    Checkpointer,
+    PrefetchIterator,
+    abstract_like,
+    create_train_state,
+    fit,
+    fit_and_export,
+    synthetic_batches,
+)
+
+
+@pytest.fixture(scope="module")
+def ckpt_spec() -> ModelSpec:
+    return register_spec(
+        ModelSpec(
+            name="ckpt-vit",
+            family="vit-tiny",
+            input_shape=(16, 16, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+            description="test-only checkpointing model",
+        )
+    )
+
+
+def _trees_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip(ckpt_spec, tmp_path):
+    tx = optax.adam(1e-3)
+    state, _ = fit(ckpt_spec, tx, synthetic_batches(ckpt_spec, 2), steps=2)
+    ckpt = Checkpointer(str(tmp_path), max_to_keep=2)
+    ckpt.save(state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 2
+    fresh = create_train_state(ckpt_spec, tx, seed=1)
+    restored = ckpt.restore(abstract_like(fresh))
+    ckpt.close()
+    assert int(restored.step) == 2
+    _trees_equal(restored.params, state.params)
+    _trees_equal(restored.opt_state, state.opt_state)
+
+
+def test_fit_resumes_from_checkpoint(ckpt_spec, tmp_path):
+    tx = optax.sgd(1e-3)
+    d = str(tmp_path / "run")
+    logs: list[str] = []
+    state1, _ = fit(
+        ckpt_spec, tx, synthetic_batches(ckpt_spec, 2), steps=2,
+        ckpt_dir=d, ckpt_every=1, log_fn=logs.append,
+    )
+    # Second invocation restores step 2 and trains only 2 more steps.
+    state2, hist = fit(
+        ckpt_spec, tx, synthetic_batches(ckpt_spec, 2), steps=4,
+        ckpt_dir=d, ckpt_every=1, log_fn=logs.append,
+    )
+    assert any("resumed" in line and "step 2" in line for line in logs)
+    assert int(state2.step) == 4
+    assert hist[-1][0] == 4
+
+
+def test_retention_prunes_old_steps(ckpt_spec, tmp_path):
+    tx = optax.sgd(1e-3)
+    state = create_train_state(ckpt_spec, tx, seed=0)
+    ckpt = Checkpointer(str(tmp_path), max_to_keep=2)
+    for step in (1, 2, 3):
+        state = type(state)(
+            state.step * 0 + step, state.params, state.batch_stats, state.opt_state
+        )
+        ckpt.save(state)
+    ckpt.wait()
+    steps = ckpt._mngr.all_steps()
+    ckpt.close()
+    assert max(steps) == 3
+    assert len(steps) <= 2
+
+
+def test_sharded_roundtrip_trains_after_restore(ckpt_spec, tmp_path):
+    # Regression: a restored state's scalar leaves (step, adam's count) come
+    # back COMMITTED to whatever sharding the abstract target carried; if
+    # create_train_state leaves them single-device while params are
+    # mesh-wide, the first post-restore train step fails with "incompatible
+    # devices".  So restore must be followed by a working sharded step.
+    from kubernetes_deep_learning_tpu.parallel.mesh import batch_sharding, make_mesh
+    from kubernetes_deep_learning_tpu.training import build_train_step
+
+    tx = optax.adam(1e-3)
+    mesh = make_mesh(8, model_parallel=2)
+    state = create_train_state(ckpt_spec, tx, seed=0, mesh=mesh)
+    ckpt = Checkpointer(str(tmp_path))
+    ckpt.save(state, force=True)
+    ckpt.wait()
+    restored = ckpt.restore(abstract_like(state))
+    ckpt.close()
+    _trees_equal(restored.params, state.params)
+
+    step_fn = build_train_step(ckpt_spec, tx, mesh=mesh)
+    images, labels = next(synthetic_batches(ckpt_spec, 8))
+    sharding = batch_sharding(mesh)
+    out, metrics = step_fn(
+        restored, jax.device_put(images, sharding), jax.device_put(labels, sharding)
+    )
+    assert int(out.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_prefetch_iterator_matches_source(ckpt_spec):
+    src = list(synthetic_batches(ckpt_spec, 2, steps=3, seed=7))
+    out = list(PrefetchIterator(iter(src)))
+    assert len(out) == 3
+    for (si, sl), (oi, ol) in zip(src, out):
+        np.testing.assert_array_equal(si, np.asarray(oi))
+        np.testing.assert_array_equal(sl, np.asarray(ol))
+
+
+def test_prefetch_close_stops_producer(ckpt_spec):
+    import threading
+
+    # Endless source + abandoned consumer: close() must unblock and join
+    # the producer thread instead of leaking it (and its staged batches).
+    it = PrefetchIterator(synthetic_batches(ckpt_spec, 2), depth=1)
+    next(it)
+    it.close()
+    assert not it._thread.is_alive()
+    assert sum(t.name == "kdlt-prefetch" for t in threading.enumerate()) == 0
+
+
+def test_fit_history_records_final_step_on_exhaustion(ckpt_spec):
+    import optax as _optax
+
+    # 2-batch source, 10 requested steps: history[-1] must be the step where
+    # training actually stopped, not the last log_every multiple.
+    state, hist = fit(
+        ckpt_spec, _optax.sgd(1e-3),
+        synthetic_batches(ckpt_spec, 2, steps=2), steps=10, log_fn=lambda s: None,
+    )
+    assert int(state.step) == 2
+    assert hist[-1][0] == 2
+
+
+def test_prefetch_propagates_source_error(ckpt_spec):
+    def bad():
+        yield next(synthetic_batches(ckpt_spec, 2))
+        raise RuntimeError("boom")
+
+    it = PrefetchIterator(bad())
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        for _ in it:
+            pass
+
+
+def test_fit_and_export_lands_served_artifact(ckpt_spec, tmp_path):
+    from kubernetes_deep_learning_tpu.export import artifact as art
+
+    tx = optax.sgd(1e-3)
+    directory = fit_and_export(
+        ckpt_spec, tx, synthetic_batches(ckpt_spec, 2), steps=1,
+        artifact_root=str(tmp_path),
+    )
+    a = art.load_artifact(directory)
+    assert a.spec.name == "ckpt-vit"
+    assert art.latest_version(str(tmp_path), "ckpt-vit") == 1
