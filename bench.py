@@ -99,6 +99,110 @@ def bench_forward(batch_sizes, scan_len, reps, dtype_name, params_dtype_name):
     return spec, results
 
 
+def bench_serving(duration_s, clients, batcher_impl, max_delay_ms, buckets):
+    """End-to-end serving benchmark: concurrent single-image requests through
+    the real HTTP model server (dynamic batcher included), measuring e2e
+    p50/p99 and aggregate throughput.
+
+    Context for reading the numbers on this machine: the TPU sits behind a
+    network tunnel with ~70 ms round trip per dispatch, which dominates e2e
+    latency here; a production pod's PCIe dispatch is tens of microseconds.
+    The mode's value on the dev box is validating the serving stack under
+    real concurrency and comparing batcher implementations (native C++ queue
+    vs python), not absolute latency.
+    """
+    import tempfile
+    import threading
+
+    import requests as rq
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    spec = get_spec("clothing-model")
+    root = tempfile.mkdtemp(prefix="kdlt-bench-")
+    # Params-only artifact (no StableHLO): the engine live-jits for the local
+    # platform, skipping a multi-minute export trace the bench doesn't need.
+    art.save_artifact(
+        art.version_dir(root, spec.name, 1),
+        spec,
+        init_variables(spec, seed=0),
+        None,
+        {"compute_dtype": "bfloat16"},
+    )
+    server = ModelServer(
+        root, port=0, buckets=buckets, max_delay_ms=max_delay_ms,
+        batcher_impl=batcher_impl, host="127.0.0.1",
+    )
+    batcher_kind = type(server.models[spec.name].batcher).__name__
+    log(f"serving bench: batcher={batcher_kind}, warming {len(buckets)} buckets...")
+    server.warmup()
+    server.start()
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(1, *spec.input_shape), dtype=np.uint8)
+    body = protocol.encode_predict_request(img)
+    url = f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict"
+    headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
+
+    latencies: list[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        s = rq.Session()
+        local = []
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                r = s.post(url, data=body, headers=headers, timeout=30)
+                ok = r.status_code == 200
+            except Exception:
+                ok = False
+            dt = time.perf_counter() - t0
+            if ok:
+                local.append(dt)
+            else:
+                with lock:
+                    errors[0] += 1
+        with lock:
+            latencies.extend(local)
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    server.shutdown()
+
+    lat = np.array(sorted(latencies))
+    if lat.size == 0:
+        log("serving bench: no successful requests")
+        return None
+    result = {
+        "batcher": batcher_kind,
+        "clients": clients,
+        "img_per_s": round(lat.size / elapsed, 1),
+        "e2e_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "e2e_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        "errors": errors[0],
+    }
+    log(
+        f"serving e2e [{batcher_kind}]: {result['img_per_s']} img/s with "
+        f"{clients} clients, p50 {result['e2e_p50_ms']} ms, "
+        f"p99 {result['e2e_p99_ms']} ms, {errors[0]} errors"
+    )
+    return result
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--batches", default="1,2,4,8,16,32,64,128")
@@ -111,7 +215,26 @@ def main() -> int:
         # artifact, so the serving default stays float32 for logit parity.
         "--params-dtype", default="float32", choices=["bfloat16", "float32"]
     )
+    p.add_argument(
+        "--serving", type=float, default=0,
+        help="ALSO run the e2e serving bench for this many seconds (0 = off)",
+    )
+    p.add_argument("--clients", type=int, default=32, help="serving-bench client threads")
+    p.add_argument(
+        "--batcher", default="auto", choices=["auto", "native", "python"],
+        help="serving-bench batching queue implementation",
+    )
+    p.add_argument("--max-delay-ms", type=float, default=2.0)
     args = p.parse_args()
+
+    if args.serving > 0:
+        bench_serving(
+            args.serving,
+            args.clients,
+            args.batcher,
+            args.max_delay_ms,
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
 
     batch_sizes = [int(b) for b in args.batches.split(",")]
     spec, results = bench_forward(

@@ -30,18 +30,23 @@ def _repo_native_dir() -> str | None:
     return candidate if os.path.isfile(os.path.join(candidate, "hostops.cc")) else None
 
 
+_SOURCES = ("hostops.cc", "batchqueue.cc")
+
+
 def _build(source_dir: str) -> str:
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
         "kdlt",
     )
     os.makedirs(cache, exist_ok=True)
-    src = os.path.join(source_dir, "hostops.cc")
+    srcs = [os.path.join(source_dir, s) for s in _SOURCES]
     out = os.path.join(cache, _LIB_NAME)
-    if os.path.isfile(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    if os.path.isfile(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs
+    ):
         return out
     cxx = os.environ.get("CXX", "g++")
-    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-o", out, src, "-pthread"]
+    cmd = [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-o", out, *srcs, "-pthread"]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     return out
 
@@ -50,14 +55,21 @@ def _find_or_build() -> str:
     explicit = os.environ.get("KDLT_NATIVE_LIB")
     if explicit:
         return explicit
+    native_dir = _repo_native_dir()
+    newest_src = max(
+        (os.path.getmtime(os.path.join(native_dir, s)) for s in _SOURCES
+         if os.path.isfile(os.path.join(native_dir, s))),
+        default=0.0,
+    ) if native_dir else 0.0
     here = os.path.dirname(os.path.abspath(__file__))
     for candidate in (
         os.path.join(here, _LIB_NAME),
         os.path.join(os.path.dirname(os.path.dirname(here)), "native", "build", _LIB_NAME),
     ):
-        if os.path.isfile(candidate):
+        # A prebuilt older than the sources may lack newly added symbols
+        # (binding would fail below); prefer rebuilding when we can.
+        if os.path.isfile(candidate) and os.path.getmtime(candidate) >= newest_src:
             return candidate
-    native_dir = _repo_native_dir()
     if native_dir is None:
         raise ImportError("no prebuilt libkdlthostops.so and no source tree")
     return _build(native_dir)
@@ -69,14 +81,34 @@ except Exception as e:  # toolchain or source missing: PIL fallback
     raise ImportError(f"native host ops unavailable: {e}") from e
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
-for _fn, _args in (
-    ("kdlt_resize_bilinear", [_u8p] + [ctypes.c_int] * 3 + [_u8p] + [ctypes.c_int] * 2),
-    ("kdlt_resize_nearest", [_u8p] + [ctypes.c_int] * 3 + [_u8p] + [ctypes.c_int] * 2),
-    ("kdlt_resize_batch", [_u8p] + [ctypes.c_int] * 4 + [_u8p] + [ctypes.c_int] * 4),
-):
-    fn = getattr(_lib, _fn)
-    fn.argtypes = _args
-    fn.restype = ctypes.c_int
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+try:
+    for _fn, _args, _ret in (
+        ("kdlt_resize_bilinear", [_u8p] + [ctypes.c_int] * 3 + [_u8p] + [ctypes.c_int] * 2, ctypes.c_int),
+        ("kdlt_resize_nearest", [_u8p] + [ctypes.c_int] * 3 + [_u8p] + [ctypes.c_int] * 2, ctypes.c_int),
+        ("kdlt_resize_batch", [_u8p] + [ctypes.c_int] * 4 + [_u8p] + [ctypes.c_int] * 4, ctypes.c_int),
+        # Batch queue (native/batchqueue.cc), consumed by runtime.native_batcher.
+        ("kdlt_bq_create", [ctypes.c_int, ctypes.c_int64, ctypes.c_int], ctypes.c_void_p),
+        ("kdlt_bq_destroy", [ctypes.c_void_p], None),
+        ("kdlt_bq_submit", [ctypes.c_void_p, _u8p], ctypes.c_int64),
+        ("kdlt_bq_take", [ctypes.c_void_p, _u8p, ctypes.c_int, ctypes.c_double, _i64p], ctypes.c_int),
+        ("kdlt_bq_complete", [ctypes.c_void_p, _i64p, ctypes.c_int, _f32p, ctypes.c_int], None),
+        ("kdlt_bq_fail", [ctypes.c_void_p, _i64p, ctypes.c_int], None),
+        ("kdlt_bq_wait", [ctypes.c_void_p, ctypes.c_int64, _f32p, ctypes.c_double], ctypes.c_int),
+        ("kdlt_bq_close", [ctypes.c_void_p], None),
+        ("kdlt_bq_abort", [ctypes.c_void_p], None),
+        ("kdlt_bq_pending", [ctypes.c_void_p], ctypes.c_int),
+    ):
+        fn = getattr(_lib, _fn)
+        fn.argtypes = _args
+        fn.restype = _ret
+except AttributeError as e:
+    # A stale prebuilt library missing newer symbols must surface as the
+    # ImportError the module contract promises (callers fall back on it).
+    raise ImportError(f"native library is stale: {e}") from e
+
+lib = _lib  # raw handle for runtime.native_batcher
 
 
 def _check(img: np.ndarray) -> np.ndarray:

@@ -1,4 +1,31 @@
 from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
 from kubernetes_deep_learning_tpu.runtime.batcher import BatcherClosed, DynamicBatcher, QueueFull
 
-__all__ = ["BatcherClosed", "DynamicBatcher", "InferenceEngine", "QueueFull"]
+
+def create_batcher(engine, impl: str = "auto", **kwargs):
+    """Pick the batching implementation.
+
+    "native" -> the C++ queue (native/batchqueue.cc); "python" -> the
+    pure-Python DynamicBatcher; "auto" -> native when the compiled library
+    is available, else Python.  Both have identical policy and surface.
+    """
+    if impl not in ("auto", "native", "python"):
+        raise ValueError(f"unknown batcher impl {impl!r}")
+    if impl in ("auto", "native"):
+        try:
+            from kubernetes_deep_learning_tpu.runtime.native_batcher import NativeBatcher
+
+            return NativeBatcher(engine, **kwargs)
+        except ImportError:
+            if impl == "native":
+                raise
+    return DynamicBatcher(engine, **kwargs)
+
+
+__all__ = [
+    "BatcherClosed",
+    "DynamicBatcher",
+    "InferenceEngine",
+    "QueueFull",
+    "create_batcher",
+]

@@ -1,0 +1,189 @@
+"""NativeBatcher: the C++ dynamic batcher (native/batchqueue.cc) binding.
+
+Same policy and surface as runtime.batcher.DynamicBatcher -- continuous
+batching with a bounded linger for stragglers, blocking ``predict`` with the
+reference's 20 s deadline -- but the queue, the linger timer, and the
+gather of request images into one contiguous batch live in C++ outside the
+GIL (ctypes releases it around every call).  This is the in-tree analog of
+the batching TF-Serving does in its C++ binary (SURVEY.md component 7):
+request threads block in native code, so a Python-side GC pause or GIL
+convoy cannot stretch the batching window.
+
+Falls back is the caller's job: model_server picks this when the native
+library is importable, else DynamicBatcher (identical semantics, pure
+Python).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.runtime.batcher import BatcherClosed, QueueFull
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+
+class NativeBatcher:
+    def __init__(
+        self,
+        engine,
+        max_batch: int | None = None,
+        max_delay_ms: float = 2.0,
+        queue_cap: int = 2048,
+        registry: metrics_lib.Registry | None = None,
+    ):
+        from kubernetes_deep_learning_tpu.ops import _native
+
+        self._lib = _native.lib
+        self._engine = engine
+        self.spec = engine.spec
+        self.max_batch = max_batch or engine.max_batch
+        self.max_delay = max_delay_ms / 1000.0
+        self.queue_cap = queue_cap
+        self._item_shape = tuple(self.spec.input_shape)
+        self._item_bytes = int(np.prod(self._item_shape))
+        self._out_floats = self.spec.num_classes
+
+        self._q = self._lib.kdlt_bq_create(
+            queue_cap, self._item_bytes, self._out_floats
+        )
+        if not self._q:
+            raise RuntimeError("kdlt_bq_create failed")
+        self._closed = False
+        self._destroyed = False
+        self._close_lock = threading.Lock()
+        # Failed-batch errors keyed by ticket, so each waiter raises ITS
+        # batch's exception (a shared last-error field would misattribute
+        # failures across batches).  Pruned defensively: abandoned waiters
+        # never pop their entries.
+        self._errors: dict[int, BaseException] = {}
+        self._errors_lock = threading.Lock()
+
+        registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
+        self._m_batch_size = registry.histogram(
+            "kdlt_batcher_batch_size",
+            "dispatched batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+        self._m_queue_full = registry.counter(
+            "kdlt_batcher_rejected_total", "requests rejected because queue was full"
+        )
+        # Dispatcher-owned staging buffers; only this thread touches them.
+        self._batch_buf = np.empty((self.max_batch, *self._item_shape), np.uint8)
+        self._tickets = np.empty(self.max_batch, np.int64)
+        self._thread = threading.Thread(
+            target=self._run, name="kdlt-native-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # --- dispatcher --------------------------------------------------------
+
+    def _run(self) -> None:
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        buf = self._batch_buf.ctypes.data_as(u8p)
+        tix = self._tickets.ctypes.data_as(i64p)
+        while True:
+            # Blocks in C (GIL released) until work or close+drain.
+            n = self._lib.kdlt_bq_take(
+                self._q, buf, self.max_batch, self.max_delay, tix
+            )
+            if n == 0:
+                return
+            self._m_batch_size.observe(n)
+            try:
+                logits = np.ascontiguousarray(
+                    self._engine.predict(self._batch_buf[:n]), dtype=np.float32
+                )
+                self._lib.kdlt_bq_complete(
+                    self._q, tix, n, logits.ctypes.data_as(f32p), self._out_floats
+                )
+            except Exception as e:  # propagate to all waiters, keep serving
+                with self._errors_lock:
+                    if len(self._errors) > 2 * self.queue_cap:
+                        self._errors.clear()
+                    for t in self._tickets[:n]:
+                        self._errors[int(t)] = e
+                self._lib.kdlt_bq_fail(self._q, tix, n)
+
+    # --- request side ------------------------------------------------------
+
+    def predict(self, image: np.ndarray, timeout: float = 20.0) -> np.ndarray:
+        """Blocking single-image predict (the reference's 20 s deadline,
+        reference model_server.py:55)."""
+        if self._closed:
+            raise BatcherClosed("batcher is shut down")
+        image = np.ascontiguousarray(image)
+        if tuple(image.shape) != self._item_shape:
+            raise ValueError(
+                f"image shape {tuple(image.shape)} != expected {self._item_shape}"
+            )
+        if image.dtype != np.uint8:
+            raise ValueError(f"batcher takes uint8 images, got {image.dtype}")
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        ticket = self._lib.kdlt_bq_submit(self._q, image.ctypes.data_as(u8p))
+        if ticket == -1:
+            self._m_queue_full.inc()
+            raise QueueFull("request queue full")
+        if ticket == -2:
+            raise BatcherClosed("batcher is shut down")
+        out = np.empty(self._out_floats, np.float32)
+        rc = self._lib.kdlt_bq_wait(
+            self._q, ticket, out.ctypes.data_as(f32p), timeout
+        )
+        if rc == 0:
+            return out
+        if rc == 1:
+            raise FuturesTimeout(f"predict timed out after {timeout}s")
+        if rc == 3:
+            raise BatcherClosed("batcher shut down while request was queued")
+        if rc == 2:
+            with self._errors_lock:
+                err = self._errors.pop(int(ticket), None)
+            if err is not None:
+                raise err
+            raise BatcherClosed("request failed during batcher shutdown")
+        raise BatcherClosed(f"batcher ticket invalid (rc={rc})")
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def close(self, drain: bool = True) -> None:
+        """Stop intake; with drain, let queued work finish first.
+
+        The C++ queue is NOT freed here: a handler thread that has passed
+        the closed-flag check may still be inside submit/wait, so freeing
+        now would be use-after-free.  close only stops the world (new
+        predicts raise BatcherClosed; native waiters are woken); the free
+        happens in __del__, which cannot run while any thread is inside a
+        method of this object.
+        """
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            if drain:
+                self._lib.kdlt_bq_close(self._q)   # queued work still served
+            else:
+                self._lib.kdlt_bq_abort(self._q)   # queued waiters fail now
+            self._thread.join(timeout=30.0)
+
+    def __del__(self):  # the only place the C++ queue is freed
+        try:
+            if not getattr(self, "_q", None) or self._destroyed:
+                return
+            if not self._closed:
+                self.close(drain=False)
+            if not self._thread.is_alive():
+                self._destroyed = True
+                # destroy additionally blocks in C until any last native
+                # waiter (possible only via a stale ticket) has left.
+                self._lib.kdlt_bq_destroy(self._q)
+        except Exception:
+            pass

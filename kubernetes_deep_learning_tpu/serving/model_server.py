@@ -39,9 +39,9 @@ import numpy as np
 from kubernetes_deep_learning_tpu.export import artifact as art
 from kubernetes_deep_learning_tpu.runtime import (
     BatcherClosed,
-    DynamicBatcher,
     InferenceEngine,
     QueueFull,
+    create_batcher,
 )
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
@@ -52,7 +52,10 @@ DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-mode
 
 
 class ServedModel:
-    def __init__(self, artifact, buckets, max_delay_ms, registry, use_batcher=True):
+    def __init__(
+        self, artifact, buckets, max_delay_ms, registry, use_batcher=True,
+        batcher_impl="auto",
+    ):
         self.artifact = artifact
         self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
         # Each model version gets a labeled child registry so two models (or
@@ -67,8 +70,11 @@ class ServedModel:
                 artifact, buckets=buckets, registry=self.registry_child
             )
             self.batcher = (
-                DynamicBatcher(
-                    self.engine, max_delay_ms=max_delay_ms, registry=self.registry_child
+                create_batcher(
+                    self.engine,
+                    impl=batcher_impl,
+                    max_delay_ms=max_delay_ms,
+                    registry=self.registry_child,
                 )
                 if use_batcher
                 else None
@@ -114,6 +120,7 @@ class ModelServer:
         max_delay_ms: float = 2.0,
         use_batcher: bool = True,
         host: str = "0.0.0.0",
+        batcher_impl: str = "auto",
     ):
         self.registry = metrics_lib.Registry()
         self._m_requests = self.registry.counter(
@@ -130,6 +137,7 @@ class ModelServer:
         self._buckets = buckets
         self._max_delay_ms = max_delay_ms
         self._use_batcher = use_batcher
+        self._batcher_impl = batcher_impl
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self.poll_versions()
@@ -200,6 +208,7 @@ class ModelServer:
                     self._max_delay_ms,
                     self.registry,
                     self._use_batcher,
+                    self._batcher_impl,
                 )
                 fresh.engine.warmup()
             except Exception as e:
@@ -359,6 +368,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-delay-ms", type=float, default=2.0)
     p.add_argument("--no-batching", action="store_true")
     p.add_argument(
+        "--batcher",
+        default="auto",
+        choices=["auto", "native", "python"],
+        help="batching queue implementation (native = C++ batchqueue.cc)",
+    )
+    p.add_argument(
         "--watch-interval",
         type=float,
         default=10.0,
@@ -381,6 +396,7 @@ def main(argv: list[str] | None = None) -> int:
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         max_delay_ms=args.max_delay_ms,
         use_batcher=not args.no_batching,
+        batcher_impl=args.batcher,
     )
     server.warmup()
     if args.watch_interval > 0:
