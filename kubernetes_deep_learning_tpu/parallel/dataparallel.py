@@ -74,11 +74,14 @@ def build_sharded_forward(spec: ModelSpec, mesh: Mesh, dtype: Any = jnp.bfloat16
 
 
 class ShardedEngine:
-    """Data-parallel serving engine over a device mesh.
+    """Data-parallel serving engine over a device mesh (library form).
 
-    Equivalent role to runtime.InferenceEngine but the batch is sharded over
-    every chip in the mesh; buckets are global batch sizes and must divide
-    evenly, so each bucket is rounded up to a multiple of the data-axis size.
+    The batch is sharded over every chip in the mesh; buckets are global
+    batch sizes rounded up to a multiple of the data-axis size.  For the
+    serving-grade variant with metrics, readiness, and batcher integration,
+    pass ``mesh=`` to runtime.InferenceEngine (the model server's
+    ``--data-parallel N`` does exactly that); both build on
+    shard_variables/build_sharded_forward above.
     """
 
     def __init__(

@@ -42,14 +42,26 @@ class InferenceEngine:
         use_exported: bool = True,
         device=None,
         registry: metrics_lib.Registry | None = None,
+        mesh=None,
     ):
+        """``mesh`` switches the engine to data-parallel serving: the batch
+        is sharded over the mesh's ``data`` axis (params replicated or
+        tensor-parallel per parallel.dataparallel's rules) and buckets are
+        rounded up to multiples of the data-axis size so every chip gets an
+        equal shard.  The exported-module path is bypassed -- the module was
+        traced for one device; the live forward jits SPMD instead."""
         import jax
 
         self.spec = artifact.spec
+        self.mesh = mesh
+        if mesh is not None:
+            from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
+
+            n_data = mesh.shape[DATA_AXIS]
+            buckets = sorted({-(-b // n_data) * n_data for b in buckets})
         self.buckets = tuple(sorted(buckets))
         self.max_batch = self.buckets[-1]
         self._device = device or jax.devices()[0]
-        self._variables = jax.device_put(artifact.variables, self._device)
         self._lock = threading.Lock()
         self._ready = threading.Event()
 
@@ -58,6 +70,24 @@ class InferenceEngine:
         # Compute dtype recorded at export time; the f32 debug path must use
         # the same dtype or it would disagree numerically with the wire path.
         self._compute_dtype = artifact.metadata.get("compute_dtype", "bfloat16")
+        if mesh is not None:
+            import jax.numpy as jnp
+
+            from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                build_sharded_forward,
+                shard_variables,
+            )
+
+            self._variables = shard_variables(artifact.variables, mesh)
+            sharded_call = build_sharded_forward(
+                self.spec, mesh, dtype=jnp.dtype(self._compute_dtype)
+            )
+            self._jitted = sharded_call
+            self._jitted_f32 = sharded_call
+            self._f32_lock = threading.Lock()
+            self._init_metrics(registry)
+            return
+        self._variables = jax.device_put(artifact.variables, self._device)
         platform = self._device.platform
         if use_exported and artifact.module_bytes_for(platform) is not None:
             self._jitted = jax.jit(artifact.exported_for(platform).call)
@@ -82,7 +112,9 @@ class InferenceEngine:
         # traffic serialized on _lock.  Concurrent dispatch of two programs
         # is safe -- the device runtime serializes execution.
         self._f32_lock = threading.Lock()
+        self._init_metrics(registry)
 
+    def _init_metrics(self, registry: metrics_lib.Registry | None) -> None:
         registry = registry or metrics_lib.Registry()
         self.registry = registry
         self._m_infer_latency = registry.histogram(

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -59,10 +60,13 @@ class NativeBatcher:
         self._close_lock = threading.Lock()
         # Failed-batch errors keyed by ticket, so each waiter raises ITS
         # batch's exception (a shared last-error field would misattribute
-        # failures across batches).  Pruned defensively: abandoned waiters
-        # never pop their entries.
-        self._errors: dict[int, BaseException] = {}
+        # failures across batches).  Entries whose waiters never woke
+        # (abandoned after timeout) are pruned by AGE -- any live waiter
+        # reads its entry within its own predict timeout, so expiring well
+        # past that can never steal an error from a live request.
+        self._errors: dict[int, tuple[BaseException, float]] = {}
         self._errors_lock = threading.Lock()
+        self._error_ttl_s = 120.0
 
         registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
         self._m_batch_size = registry.histogram(
@@ -105,11 +109,16 @@ class NativeBatcher:
                     self._q, tix, n, logits.ctypes.data_as(f32p), self._out_floats
                 )
             except Exception as e:  # propagate to all waiters, keep serving
+                now = time.monotonic()
                 with self._errors_lock:
-                    if len(self._errors) > 2 * self.queue_cap:
-                        self._errors.clear()
+                    expired = [
+                        t for t, (_, ts) in self._errors.items()
+                        if now - ts > self._error_ttl_s
+                    ]
+                    for t in expired:
+                        del self._errors[t]
                     for t in self._tickets[:n]:
-                        self._errors[int(t)] = e
+                        self._errors[int(t)] = (e, now)
                 self._lib.kdlt_bq_fail(self._q, tix, n)
 
     # --- request side ------------------------------------------------------
@@ -146,9 +155,9 @@ class NativeBatcher:
             raise BatcherClosed("batcher shut down while request was queued")
         if rc == 2:
             with self._errors_lock:
-                err = self._errors.pop(int(ticket), None)
-            if err is not None:
-                raise err
+                entry = self._errors.pop(int(ticket), None)
+            if entry is not None:
+                raise entry[0]
             raise BatcherClosed("request failed during batcher shutdown")
         raise BatcherClosed(f"batcher ticket invalid (rc={rc})")
 

@@ -20,6 +20,10 @@ Endpoints::
     GET  /v1/models/<name>             the ModelSpec (the discoverable
                                        contract; replaces saved_model_cli)
     GET  /healthz | /readyz | /metrics
+    POST /debug/profile                capture a jax.profiler device trace
+                                       ({"seconds": s, "dir": path}); the
+                                       tracing hook SURVEY.md section 5 notes
+                                       the reference lacks entirely
 """
 
 from __future__ import annotations
@@ -54,7 +58,7 @@ DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-mode
 class ServedModel:
     def __init__(
         self, artifact, buckets, max_delay_ms, registry, use_batcher=True,
-        batcher_impl="auto",
+        batcher_impl="auto", mesh=None,
     ):
         self.artifact = artifact
         self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
@@ -67,7 +71,7 @@ class ServedModel:
         )
         try:
             self.engine = InferenceEngine(
-                artifact, buckets=buckets, registry=self.registry_child
+                artifact, buckets=buckets, registry=self.registry_child, mesh=mesh
             )
             self.batcher = (
                 create_batcher(
@@ -121,6 +125,7 @@ class ModelServer:
         use_batcher: bool = True,
         host: str = "0.0.0.0",
         batcher_impl: str = "auto",
+        mesh=None,
     ):
         self.registry = metrics_lib.Registry()
         self._m_requests = self.registry.counter(
@@ -138,8 +143,10 @@ class ModelServer:
         self._max_delay_ms = max_delay_ms
         self._use_batcher = use_batcher
         self._batcher_impl = batcher_impl
+        self._mesh = mesh
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
+        self._profile_lock = threading.Lock()
         self.poll_versions()
         if not self.models:
             raise FileNotFoundError(f"no model artifacts under {model_root!r}")
@@ -209,6 +216,7 @@ class ModelServer:
                     self.registry,
                     self._use_batcher,
                     self._batcher_impl,
+                    self._mesh,
                 )
                 fresh.engine.warmup()
             except Exception as e:
@@ -294,6 +302,8 @@ class ModelServer:
             def do_POST(self):
                 from kubernetes_deep_learning_tpu.serving import protocol
 
+                if self.path == "/debug/profile":
+                    return self._profile()
                 t0 = time.perf_counter()
                 server._m_requests.inc()
                 m = _PREDICT_RE.match(self.path)
@@ -332,6 +342,44 @@ class ModelServer:
                     self._send_json(500, {"error": str(e)})
                 finally:
                     server._m_latency.observe(time.perf_counter() - t0)
+
+            def _profile(self):
+                """Capture a jax.profiler trace while live traffic runs.
+
+                Blocks the calling client for ``seconds``; serving continues
+                on the other handler threads, which is the point -- the
+                trace shows real request execution on the device.
+                """
+                import tempfile
+
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length)) if length else {}
+                    if not isinstance(req, dict):
+                        raise ValueError("body must be a JSON object")
+                    seconds = float(req.get("seconds", 2.0))
+                    if not 0 < seconds <= 60:
+                        raise ValueError("seconds must be in (0, 60]")
+                    trace_dir = req.get("dir") or tempfile.mkdtemp(prefix="kdlt-trace-")
+                    if not isinstance(trace_dir, str):
+                        raise ValueError("dir must be a string path")
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    return self._send_json(400, {"error": str(e)})
+                if not server._profile_lock.acquire(blocking=False):
+                    return self._send_json(
+                        409, {"error": "a profile capture is already running"}
+                    )
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(trace_dir)
+                    time.sleep(seconds)
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    return self._send_json(500, {"error": str(e)})
+                finally:
+                    server._profile_lock.release()
+                self._send_json(200, {"trace_dir": trace_dir, "seconds": seconds})
 
         return Handler
 
@@ -374,6 +422,13 @@ def main(argv: list[str] | None = None) -> int:
         help="batching queue implementation (native = C++ batchqueue.cc)",
     )
     p.add_argument(
+        "--data-parallel",
+        type=int,
+        default=0,
+        help="serve data-parallel over this many local chips (0 = one device); "
+        "the batch is sharded over a jax Mesh, XLA replicates params over ICI",
+    )
+    p.add_argument(
         "--watch-interval",
         type=float,
         default=10.0,
@@ -390,6 +445,12 @@ def main(argv: list[str] | None = None) -> int:
 
     force_platform(args.platform)
 
+    mesh = None
+    if args.data_parallel > 0:
+        from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.data_parallel)
+
     server = ModelServer(
         args.models,
         port=args.port,
@@ -397,6 +458,7 @@ def main(argv: list[str] | None = None) -> int:
         max_delay_ms=args.max_delay_ms,
         use_batcher=not args.no_batching,
         batcher_impl=args.batcher,
+        mesh=mesh,
     )
     server.warmup()
     if args.watch_interval > 0:

@@ -61,7 +61,10 @@ struct BatchQueue {
   BatchQueue(int capacity, int64_t item_bytes_, int out_floats_)
       : slots(capacity), item_bytes(item_bytes_), out_floats(out_floats_) {
     for (int i = 0; i < capacity; ++i) {
-      slots[i].image.resize(item_bytes);
+      // Image buffers are allocated lazily on first use (submit): eagerly
+      // sizing capacity x item_bytes would pin ~550 MB for a 2048-deep
+      // 299x299x3 queue, where actual residency only needs the high-water
+      // mark of concurrent requests.  The tiny logits row is eager.
       slots[i].out.resize(out_floats);
       free_slots.push_back(i);
     }
@@ -152,6 +155,8 @@ int64_t kdlt_bq_submit(void* handle, const uint8_t* image) {
     // Copy under the lock: the slot buffer is exclusively ours once popped,
     // but the pending publish must not precede the copy.  Unlock-copy-relock
     // would also be correct; a ~270 KB memcpy is cheap enough to keep simple.
+    if (q->slots[idx].image.size() < static_cast<size_t>(q->item_bytes))
+      q->slots[idx].image.resize(q->item_bytes);  // lazy, kept thereafter
     std::memcpy(q->slots[idx].image.data(), image, q->item_bytes);
     q->slots[idx].state = SlotState::kPending;
     q->pending.push_back(idx);
@@ -265,7 +270,9 @@ void kdlt_bq_fail(void* handle, const int64_t* tickets, int n) {
 
 // Request side: block until the ticket resolves.  0 = ok (row in out),
 // 1 = timeout (slot marked abandoned; its capacity is reclaimed later),
-// 2 = failed, 3 = queue closed before completion, 4 = stale ticket.
+// 2 = failed (engine error, or the queue was aborted/destroyed),
+// 4 = stale ticket.  A drain-close keeps queued waiters waiting for their
+// results rather than failing them.
 int kdlt_bq_wait(void* handle, int64_t ticket, float* out, double timeout_s) {
   auto* q = static_cast<BatchQueue*>(handle);
   int idx;
@@ -299,14 +306,11 @@ int kdlt_bq_wait(void* handle, int64_t ticket, float* out, double timeout_s) {
       rc = 2;
       break;
     }
-    if (q->closed && s.state == SlotState::kPending) {
-      // Do NOT free here: the index is still in the pending deque and the
-      // dispatcher's drain may pop it concurrently; flag it and let
-      // take/complete reclaim, exactly like the timeout path.
-      s.abandoned = true;
-      rc = 3;
-      break;
-    }
+    // NOTE deliberately no closed+kPending early-out: close() means DRAIN
+    // (matching DynamicBatcher.close(drain=True)) -- the dispatcher keeps
+    // taking until the queue is empty, so a queued waiter just keeps
+    // waiting for its result; abort()/destroy() fail the slots instead,
+    // which resolves waiters through the kFailed branch above.
     if (timed_out) {
       // Genuinely unresolved past the deadline: flag the slot so
       // take/complete reclaims it; the result (if any) is dropped.

@@ -184,6 +184,30 @@ def test_close_without_drain_rejects_new_requests():
         b.predict(_img(1))
 
 
+def test_close_with_drain_serves_queued_work():
+    # Parity with DynamicBatcher.close(drain=True): requests queued at close
+    # time must be SERVED, not failed with BatcherClosed.
+    eng = FakeEngine(delay_s=0.1)
+    b = NativeBatcher(eng, max_delay_ms=0, queue_cap=8)
+    outs, errs = [], []
+
+    def worker(v):
+        try:
+            outs.append(b.predict(_img(v)))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(v,)) for v in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)  # let them queue while batch 1 is in flight
+    b.close(drain=True)
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(outs) == 4
+
+
 def test_served_through_model_server(tmp_path):
     # End to end: a real artifact served with batcher_impl="native".
     import requests
