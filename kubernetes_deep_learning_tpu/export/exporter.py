@@ -100,15 +100,20 @@ def export_model(
         # the ViT's Pallas attention) cannot co-lower into one multi-platform
         # module -- every branch is kept and lowered for every platform, so
         # the Mosaic kernel hits the CPU rule.  Trace one single-platform
-        # module each instead; the loader picks by runtime platform.  Only
-        # that lowering failure triggers the fallback: any other ValueError
-        # (bad spec, shape mismatch) would just re-trace into the same error.
-        if len(platforms) <= 1 or "interpret mode" not in str(e):
+        # module each instead; the loader picks by runtime platform.  Any
+        # multi-platform ValueError triggers the fallback (matching JAX's
+        # error wording would be fragile across versions); if the fallback
+        # fails too, the multi-platform error is primary with the
+        # per-platform one chained as its cause -- both stay visible.
+        if len(platforms) <= 1:
             raise
-        exported_bytes = {
-            p: trace_forward(spec, variables, dtype=dtype, platforms=(p,))
-            for p in platforms
-        }
+        try:
+            exported_bytes = {
+                p: trace_forward(spec, variables, dtype=dtype, platforms=(p,))
+                for p in platforms
+            }
+        except ValueError as per_platform_err:
+            raise e from per_platform_err
         layout = "per-platform"
     metadata = {
         "jax_version": jax.__version__,

@@ -4,6 +4,9 @@ from kubernetes_deep_learning_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from kubernetes_deep_learning_tpu.parallel.longseq import (
+    build_sequence_parallel_forward,
+)
 from kubernetes_deep_learning_tpu.parallel.dataparallel import (
     ShardedEngine,
     build_sharded_forward,
@@ -13,6 +16,7 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "ShardedEngine",
+    "build_sequence_parallel_forward",
     "build_sharded_forward",
     "make_mesh",
     "replicated",

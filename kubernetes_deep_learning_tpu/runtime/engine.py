@@ -43,18 +43,26 @@ class InferenceEngine:
         device=None,
         registry: metrics_lib.Registry | None = None,
         mesh=None,
+        mesh_mode: str = "data",
     ):
-        """``mesh`` switches the engine to data-parallel serving: the batch
-        is sharded over the mesh's ``data`` axis (params replicated or
-        tensor-parallel per parallel.dataparallel's rules) and buckets are
-        rounded up to multiples of the data-axis size so every chip gets an
-        equal shard.  The exported-module path is bypassed -- the module was
-        traced for one device; the live forward jits SPMD instead."""
+        """``mesh`` switches the engine to SPMD serving over the mesh.
+        mesh_mode "data": the batch is sharded over the ``data`` axis
+        (params replicated or tensor-parallel per parallel.dataparallel's
+        rules) and buckets are rounded up to multiples of the axis size so
+        every chip gets an equal shard.  mesh_mode "sequence": context
+        parallelism -- the TOKEN axis is sharded and attention runs the ring
+        schedule (parallel.longseq; vit families only), for inputs whose
+        sequence would not fit one chip.  Either way the exported-module
+        path is bypassed: the module was traced for one device; the live
+        forward jits SPMD instead."""
         import jax
 
+        if mesh_mode not in ("data", "sequence"):
+            raise ValueError(f"unknown mesh_mode {mesh_mode!r}")
         self.spec = artifact.spec
         self.mesh = mesh
-        if mesh is not None:
+        self.mesh_mode = mesh_mode
+        if mesh is not None and mesh_mode == "data":
             from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
 
             n_data = mesh.shape[DATA_AXIS]
@@ -73,15 +81,28 @@ class InferenceEngine:
         if mesh is not None:
             import jax.numpy as jnp
 
-            from kubernetes_deep_learning_tpu.parallel.dataparallel import (
-                build_sharded_forward,
-                shard_variables,
-            )
+            if mesh_mode == "sequence":
+                from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                    shard_variables,
+                )
+                from kubernetes_deep_learning_tpu.parallel.longseq import (
+                    build_sequence_parallel_forward,
+                )
 
-            self._variables = shard_variables(artifact.variables, mesh)
-            sharded_call = build_sharded_forward(
-                self.spec, mesh, dtype=jnp.dtype(self._compute_dtype)
-            )
+                self._variables = shard_variables(artifact.variables, mesh)
+                sharded_call = build_sequence_parallel_forward(
+                    self.spec, mesh, dtype=jnp.dtype(self._compute_dtype)
+                )
+            else:
+                from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                    build_sharded_forward,
+                    shard_variables,
+                )
+
+                self._variables = shard_variables(artifact.variables, mesh)
+                sharded_call = build_sharded_forward(
+                    self.spec, mesh, dtype=jnp.dtype(self._compute_dtype)
+                )
             self._jitted = sharded_call
             self._jitted_f32 = sharded_call
             self._f32_lock = threading.Lock()

@@ -151,8 +151,6 @@ class NativeBatcher:
             return out
         if rc == 1:
             raise FuturesTimeout(f"predict timed out after {timeout}s")
-        if rc == 3:
-            raise BatcherClosed("batcher shut down while request was queued")
         if rc == 2:
             with self._errors_lock:
                 entry = self._errors.pop(int(ticket), None)

@@ -21,15 +21,19 @@ Endpoints::
                                        contract; replaces saved_model_cli)
     GET  /healthz | /readyz | /metrics
     POST /debug/profile                capture a jax.profiler device trace
-                                       ({"seconds": s, "dir": path}); the
-                                       tracing hook SURVEY.md section 5 notes
-                                       the reference lacks entirely
+                                       ({"seconds": s}); traces land in
+                                       fresh directories under the server's
+                                       --profile-dir (never a client-chosen
+                                       path).  The tracing hook SURVEY.md
+                                       section 5 notes the reference lacks
+                                       entirely; disable with --no-profiling
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import sys
 import threading
@@ -58,7 +62,7 @@ DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-mode
 class ServedModel:
     def __init__(
         self, artifact, buckets, max_delay_ms, registry, use_batcher=True,
-        batcher_impl="auto", mesh=None,
+        batcher_impl="auto", mesh=None, mesh_mode="data",
     ):
         self.artifact = artifact
         self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
@@ -71,7 +75,8 @@ class ServedModel:
         )
         try:
             self.engine = InferenceEngine(
-                artifact, buckets=buckets, registry=self.registry_child, mesh=mesh
+                artifact, buckets=buckets, registry=self.registry_child,
+                mesh=mesh, mesh_mode=mesh_mode,
             )
             self.batcher = (
                 create_batcher(
@@ -126,7 +131,16 @@ class ModelServer:
         host: str = "0.0.0.0",
         batcher_impl: str = "auto",
         mesh=None,
+        mesh_mode: str = "data",
+        profile_base: str | None = "",
     ):
+        # profile_base: directory for /debug/profile traces; "" means a
+        # default under the system temp dir, None disables the endpoint.
+        if profile_base == "":
+            import tempfile as _tf
+
+            profile_base = os.path.join(_tf.gettempdir(), "kdlt-traces")
+        self._profile_base = profile_base
         self.registry = metrics_lib.Registry()
         self._m_requests = self.registry.counter(
             "kdlt_server_requests_total", "predict requests"
@@ -144,6 +158,7 @@ class ModelServer:
         self._use_batcher = use_batcher
         self._batcher_impl = batcher_impl
         self._mesh = mesh
+        self._mesh_mode = mesh_mode
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self._profile_lock = threading.Lock()
@@ -217,6 +232,7 @@ class ModelServer:
                     self._use_batcher,
                     self._batcher_impl,
                     self._mesh,
+                    self._mesh_mode,
                 )
                 fresh.engine.warmup()
             except Exception as e:
@@ -352,6 +368,8 @@ class ModelServer:
                 """
                 import tempfile
 
+                if server._profile_base is None:
+                    return self._send_json(404, {"error": "profiling disabled"})
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length)) if length else {}
@@ -360,9 +378,14 @@ class ModelServer:
                     seconds = float(req.get("seconds", 2.0))
                     if not 0 < seconds <= 60:
                         raise ValueError("seconds must be in (0, 60]")
-                    trace_dir = req.get("dir") or tempfile.mkdtemp(prefix="kdlt-trace-")
-                    if not isinstance(trace_dir, str):
-                        raise ValueError("dir must be a string path")
+                    # Client input never chooses the path: traces go into a
+                    # fresh dir under the operator-configured base (an
+                    # arbitrary "dir" would let any in-cluster client write
+                    # into e.g. the artifact root the version watcher scans).
+                    os.makedirs(server._profile_base, exist_ok=True)
+                    trace_dir = tempfile.mkdtemp(
+                        prefix="kdlt-trace-", dir=server._profile_base
+                    )
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     return self._send_json(400, {"error": str(e)})
                 if not server._profile_lock.acquire(blocking=False):
@@ -429,6 +452,24 @@ def main(argv: list[str] | None = None) -> int:
         "the batch is sharded over a jax Mesh, XLA replicates params over ICI",
     )
     p.add_argument(
+        "--parallel-mode",
+        default="data",
+        choices=["data", "sequence"],
+        help="with --data-parallel: shard the batch (data) or the token "
+        "sequence via ring attention (sequence; vit families only)",
+    )
+    p.add_argument(
+        "--profile-dir",
+        default="",
+        help="base directory for /debug/profile traces (default: a kdlt-traces "
+        "dir under the system temp dir)",
+    )
+    p.add_argument(
+        "--no-profiling",
+        action="store_true",
+        help="disable the /debug/profile endpoint",
+    )
+    p.add_argument(
         "--watch-interval",
         type=float,
         default=10.0,
@@ -459,6 +500,8 @@ def main(argv: list[str] | None = None) -> int:
         use_batcher=not args.no_batching,
         batcher_impl=args.batcher,
         mesh=mesh,
+        mesh_mode=args.parallel_mode,
+        profile_base=None if args.no_profiling else args.profile_dir,
     )
     server.warmup()
     if args.watch_interval > 0:

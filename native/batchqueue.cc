@@ -325,9 +325,10 @@ int kdlt_bq_wait(void* handle, int64_t ticket, float* out, double timeout_s) {
   return rc;
 }
 
-// Stop accepting work and wake everyone.  Pending requests fail with
-// rc=3 at their next wakeup; the dispatcher's take() drains what it can
-// and then returns 0.
+// Stop accepting work (drain-close): new submits return -2, but queued
+// requests are still taken, completed, and delivered; the dispatcher's
+// take() returns 0 once the queue is empty.  Use abort/destroy to fail
+// unresolved requests instead.
 void kdlt_bq_close(void* handle) {
   auto* q = static_cast<BatchQueue*>(handle);
   {

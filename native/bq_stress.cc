@@ -1,0 +1,126 @@
+// Batch-queue stress driver for race detection (SURVEY.md section 5: the
+// dynamic batcher is where this framework has real shared-state concurrency,
+// so it gets an explicit sanitizer harness -- the reference has nothing to
+// sanitize because its gateway state is per-process globals).
+//
+//   make -C native stress      # builds with -fsanitize=thread and runs
+//
+// Scenario per iteration: one dispatcher thread (take -> fake "inference"
+// -> complete, with occasional injected failures) against many producer
+// threads hammering submit/wait with a mix of generous and tiny timeouts
+// (tiny ones force the abandoned-slot reclamation paths).  Ends with a
+// drain-close while traffic is still in flight, then a full teardown.
+// Exit code 0 = every invariant held under the sanitizer.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* kdlt_bq_create(int capacity, int64_t item_bytes, int out_floats);
+void kdlt_bq_destroy(void* q);
+int64_t kdlt_bq_submit(void* q, const uint8_t* image);
+int kdlt_bq_take(void* q, uint8_t* dst, int max_batch, double max_delay_s,
+                 int64_t* tickets);
+void kdlt_bq_complete(void* q, const int64_t* tickets, int n,
+                      const float* logits, int row_floats);
+void kdlt_bq_fail(void* q, const int64_t* tickets, int n);
+int kdlt_bq_wait(void* q, int64_t ticket, float* out, double timeout_s);
+void kdlt_bq_close(void* q);
+}
+
+namespace {
+
+constexpr int kItemBytes = 64;
+constexpr int kOutFloats = 2;
+constexpr int kCapacity = 32;
+constexpr int kMaxBatch = 8;
+constexpr int kProducers = 16;
+constexpr int kRequestsPerProducer = 400;
+
+std::atomic<long> ok{0}, timeouts{0}, failed{0}, rejected{0}, closed{0},
+    mismatches{0};
+
+void producer(void* q, int id) {
+  uint8_t img[kItemBytes];
+  float out[kOutFloats];
+  for (int i = 0; i < kRequestsPerProducer; ++i) {
+    const uint8_t tag = static_cast<uint8_t>((id * 31 + i) % 251);
+    std::memset(img, tag, sizeof(img));
+    int64_t t = kdlt_bq_submit(q, img);
+    if (t == -1) {
+      rejected.fetch_add(1);
+      continue;
+    }
+    if (t == -2) {
+      closed.fetch_add(1);
+      return;  // queue closed under us; expected near the end
+    }
+    // Every 7th request uses a near-zero deadline to exercise abandonment.
+    const double timeout = (i % 7 == 6) ? 1e-4 : 5.0;
+    int rc = kdlt_bq_wait(q, t, out, timeout);
+    if (rc == 0) {
+      // Result integrity: the dispatcher echoes sum(img) = tag * kItemBytes.
+      if (out[0] != static_cast<float>(tag) * kItemBytes) mismatches.fetch_add(1);
+      ok.fetch_add(1);
+    } else if (rc == 1) {
+      timeouts.fetch_add(1);
+    } else {
+      failed.fetch_add(1);
+    }
+  }
+}
+
+void dispatcher(void* q) {
+  std::vector<uint8_t> buf(static_cast<size_t>(kMaxBatch) * kItemBytes);
+  int64_t tickets[kMaxBatch];
+  float logits[kMaxBatch * kOutFloats];
+  long batches = 0;
+  for (;;) {
+    int n = kdlt_bq_take(q, buf.data(), kMaxBatch, 0.0005, tickets);
+    if (n == 0) return;  // closed and drained
+    ++batches;
+    if (batches % 97 == 0) {  // injected engine failure
+      kdlt_bq_fail(q, tickets, n);
+      continue;
+    }
+    for (int i = 0; i < n; ++i) {
+      long sum = 0;
+      for (int b = 0; b < kItemBytes; ++b) sum += buf[i * kItemBytes + b];
+      logits[i * kOutFloats] = static_cast<float>(sum);
+      logits[i * kOutFloats + 1] = static_cast<float>(2 * sum);
+    }
+    kdlt_bq_complete(q, tickets, n, logits, kOutFloats);
+  }
+}
+
+}  // namespace
+
+int main() {
+  void* q = kdlt_bq_create(kCapacity, kItemBytes, kOutFloats);
+  if (!q) {
+    std::fprintf(stderr, "create failed\n");
+    return 1;
+  }
+  std::thread disp(dispatcher, q);
+  std::vector<std::thread> prods;
+  for (int i = 0; i < kProducers; ++i) prods.emplace_back(producer, q, i);
+  // Drain-close while some producers are likely still submitting: late
+  // submits must see -2, queued work must still be served.
+  prods[0].join();
+  kdlt_bq_close(q);
+  for (size_t i = 1; i < prods.size(); ++i) prods[i].join();
+  disp.join();
+  kdlt_bq_destroy(q);
+
+  std::printf(
+      "ok=%ld timeouts=%ld failed=%ld rejected=%ld closed=%ld mismatches=%ld\n",
+      ok.load(), timeouts.load(), failed.load(), rejected.load(), closed.load(),
+      mismatches.load());
+  if (mismatches.load() != 0) return 1;
+  if (ok.load() == 0) return 1;  // the harness must exercise the happy path
+  return 0;
+}

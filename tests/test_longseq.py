@@ -1,0 +1,102 @@
+"""Sequence-parallel ViT (parallel/longseq.py): the context-parallel serving
+schedule must be numerically the SAME MODEL as the single-device flax module."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel.longseq import build_sequence_parallel_forward
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def ls_spec() -> ModelSpec:
+    # 32x32 / patch 8 -> 16 tokens, sharded 4 ways over the mesh.
+    return register_spec(
+        ModelSpec(
+            name="longseq-vit",
+            family="vit-tiny",
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+            description="test-only sequence-parallel vit",
+        )
+    )
+
+
+def test_matches_single_device_module(ls_spec):
+    variables = init_variables(ls_spec, seed=0)
+    mesh = make_mesh(4)
+    fwd_sp = build_sequence_parallel_forward(ls_spec, mesh, dtype=jnp.float32)
+    fwd_ref = build_forward(ls_spec, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(3, *ls_spec.input_shape), dtype=np.uint8)
+    got = np.asarray(fwd_sp(variables, images))
+    want = np.asarray(fwd_ref(variables, images))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_float32_prenormalized_path(ls_spec):
+    variables = init_variables(ls_spec, seed=0)
+    mesh = make_mesh(4)
+    fwd_sp = build_sequence_parallel_forward(ls_spec, mesh, dtype=jnp.float32)
+    fwd_ref = build_forward(ls_spec, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, *ls_spec.input_shape)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(fwd_sp(variables, x)), np.asarray(fwd_ref(variables, x)), atol=1e-4
+    )
+
+
+def test_served_sequence_parallel(ls_spec, tmp_path):
+    # The engine's mesh_mode="sequence" through the full HTTP server.
+    import requests
+
+    from kubernetes_deep_learning_tpu.export.exporter import export_model
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    export_model(ls_spec, init_variables(ls_spec, seed=0), str(tmp_path))
+    server = ModelServer(
+        str(tmp_path), port=0, buckets=(1, 4), mesh=make_mesh(4),
+        mesh_mode="sequence",
+    )
+    try:
+        server.warmup()
+        server.start()
+        r = requests.post(
+            f"http://localhost:{server.port}/v1/models/{ls_spec.name}:predict",
+            json={"instances": np.zeros((2, *ls_spec.input_shape), np.uint8).tolist()},
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+        assert len(r.json()["predictions"]) == 2
+    finally:
+        server.shutdown()
+
+
+def test_rejects_non_vit_and_indivisible(ls_spec):
+    mesh = make_mesh(8)
+    cnn = register_spec(
+        ModelSpec(
+            name="longseq-cnn",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("a", "b"),
+            preprocessing="tf",
+        )
+    )
+    with pytest.raises(ValueError, match="vit family"):
+        build_sequence_parallel_forward(cnn, mesh)
+    odd = register_spec(
+        ModelSpec(
+            name="longseq-odd",
+            family="vit-tiny",
+            input_shape=(24, 32, 3),  # 3x4 = 12 tokens, not divisible by 8
+            labels=("a", "b"),
+            preprocessing="tf",
+        )
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        build_sequence_parallel_forward(odd, mesh)

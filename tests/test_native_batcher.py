@@ -200,7 +200,17 @@ def test_close_with_drain_serves_queued_work():
     threads = [threading.Thread(target=worker, args=(v,)) for v in range(4)]
     for t in threads:
         t.start()
-    time.sleep(0.02)  # let them queue while batch 1 is in flight
+    # Positive handshake, not a sleep: close only once all 4 requests are
+    # observably submitted (taken into a batch or still pending in C++) --
+    # a fixed delay races thread startup on a loaded machine.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        submitted = sum(eng.batch_sizes) + b._lib.kdlt_bq_pending(b._q)
+        if submitted >= 4:
+            break
+        time.sleep(0.005)
+    else:  # pragma: no cover
+        pytest.fail("requests never queued")
     b.close(drain=True)
     for t in threads:
         t.join()

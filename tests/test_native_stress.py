@@ -1,0 +1,31 @@
+"""Run the batch-queue concurrency stress harness (native/bq_stress.cc).
+
+The plain build runs here as a correctness invariant check (result
+integrity under 16-thread contention, injected failures, tiny-timeout
+abandonment, drain-close mid-traffic).  The ThreadSanitizer variant is a
+Makefile target (``make -C native stress``) for toolchains that ship the
+tsan runtime.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_bq_stress_invariants_hold():
+    build = subprocess.run(
+        ["make", "-C", NATIVE_DIR, "build/bq_stress"],
+        capture_output=True, text=True,
+    )
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run(
+        [os.path.join(NATIVE_DIR, "build", "bq_stress")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "mismatches=0" in run.stdout
