@@ -37,7 +37,7 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_forward(batch_sizes, scan_len, reps, dtype_name, params_dtype_name):
+def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_name):
     import jax
     import jax.numpy as jnp
 
@@ -45,7 +45,7 @@ def bench_forward(batch_sizes, scan_len, reps, dtype_name, params_dtype_name):
     from kubernetes_deep_learning_tpu.models import build_forward, init_variables
     from kubernetes_deep_learning_tpu.modelspec import get_spec
 
-    spec = get_spec("clothing-model")
+    spec = get_spec(model)
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     dev = jax.devices()[0]
     log(f"device: {dev}, compute dtype: {dtype_name}, params dtype: {params_dtype_name}")
@@ -205,6 +205,8 @@ def bench_serving(duration_s, clients, batcher_impl, max_delay_ms, buckets):
 
 def main() -> int:
     p = argparse.ArgumentParser()
+    p.add_argument("--model", default="clothing-model",
+                   help="ModelSpec name to bench (see modelspec.list_specs)")
     p.add_argument("--batches", default="1,2,4,8,16,32,64,128")
     p.add_argument("--scan-len", type=int, default=30, help="fwd passes per timed call")
     p.add_argument("--reps", type=int, default=5, help="timed calls per batch size")
@@ -238,7 +240,8 @@ def main() -> int:
 
     batch_sizes = [int(b) for b in args.batches.split(",")]
     spec, results = bench_forward(
-        batch_sizes, args.scan_len, args.reps, args.dtype, args.params_dtype
+        args.model, batch_sizes, args.scan_len, args.reps, args.dtype,
+        args.params_dtype,
     )
 
     # Headline: batch=32 throughput on one chip (BASELINE.json config 2).
@@ -246,7 +249,7 @@ def main() -> int:
     r = results[headline_batch]
     value = r["img_per_s"]
     out = {
-        "metric": f"xception-clothing images/sec/chip (batch={headline_batch}, "
+        "metric": f"{spec.name} images/sec/chip (batch={headline_batch}, "
         f"{args.dtype} compute, {args.params_dtype} params, "
         f"device p50={r['p50_ms']:.2f}ms/batch)",
         "value": round(value, 1),

@@ -1,0 +1,109 @@
+"""Golden-logit verification: the reference's correctness baseline as a tool.
+
+The reference's only correctness artifact is a human-checked score dict for
+one test image ("score for pants is the highest", reference guide.md:623-629)
+-- the expected logits below are transcribed from reference guide.md:623-625
+(see BASELINE.md).  This CLI makes that check executable: given the
+transfer-learned Keras weights (``xception_v4_large_08_0.894.h5``, obtained
+out-of-band per reference guide.md:176 -- this environment has no egress) and
+the pants test image, it imports the weights, runs the in-tree engine, and
+asserts every logit within tolerance.
+
+Run against a live stack instead with ``--gateway`` to check the full
+HTTP path (gateway -> model server) rather than the engine in-process.
+
+CLI::
+
+    kdlt-verify-golden --weights xception_v4_large_08_0.894.h5 --image pants.jpg
+    kdlt-verify-golden --image pants.jpg --gateway http://localhost:9696 --image-url <url>
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+# Transcribed from reference guide.md:623-625 (and BASELINE.md).
+GOLDEN_LOGITS = {
+    "dress": -1.868,
+    "hat": -4.761,
+    "longsleeve": -2.316,
+    "outwear": -1.062,
+    "pants": 9.887,
+    "shirt": -2.812,
+    "shoes": -3.666,
+    "shorts": 3.200,
+    "skirt": -2.602,
+    "t-shirt": -4.835,
+}
+
+
+def check_scores(scores: dict, atol: float) -> list[str]:
+    """Compare a {label: logit} dict to the golden values; return failures."""
+    failures = []
+    for label, want in GOLDEN_LOGITS.items():
+        got = scores.get(label)
+        if got is None:
+            failures.append(f"{label}: missing from response")
+        elif abs(got - want) > atol:
+            failures.append(f"{label}: got {got:.3f}, want {want:.3f} (atol {atol})")
+    top = max(scores, key=scores.get) if scores else None
+    if top != "pants":
+        failures.append(f"top-1 is {top!r}, want 'pants' (reference guide.md:628)")
+    return failures
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description="verify the reference golden logits")
+    p.add_argument("--image", help="local path to the pants test image")
+    p.add_argument("--weights", help="Keras .h5 weights (engine-level check)")
+    p.add_argument("--gateway", help="gateway URL (full-stack check instead)")
+    p.add_argument("--image-url", help="image URL for the gateway check")
+    p.add_argument("--atol", type=float, default=0.05,
+                   help="per-logit absolute tolerance (bf16 serving: try 0.2)")
+    p.add_argument("--platform", default=None, help="jax platform override")
+    args = p.parse_args(argv)
+
+    if args.gateway:
+        if not args.image_url:
+            p.error("--gateway needs --image-url")
+        from kubernetes_deep_learning_tpu.serving.client import predict_url
+
+        scores = predict_url(args.gateway, args.image_url)
+    else:
+        if not (args.weights and args.image):
+            p.error("engine check needs --weights and --image")
+        from kubernetes_deep_learning_tpu.utils.platform import force_platform
+
+        force_platform(args.platform)
+
+        from kubernetes_deep_learning_tpu.export import artifact as art
+        from kubernetes_deep_learning_tpu.modelspec import get_spec
+        from kubernetes_deep_learning_tpu.models.keras_import import load_keras_h5
+        from kubernetes_deep_learning_tpu.ops import preprocess
+        from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
+
+        spec = get_spec("clothing-model")
+        variables = load_keras_h5(spec, args.weights)
+        with open(args.image, "rb") as f:
+            image = preprocess.preprocess_bytes(
+                f.read(), spec.input_shape[:2], filter=spec.resize_filter
+            )
+        artifact = art.ModelArtifact(
+            spec, variables, None, {"compute_dtype": "float32"}, path="<in-memory>/1"
+        )
+        engine = InferenceEngine(artifact, buckets=(1,), use_exported=False)
+        scores = engine.predict_scores(image[None])[0]
+
+    print("scores:", {k: round(v, 3) for k, v in sorted(scores.items())})
+    failures = check_scores(scores, args.atol)
+    if failures:
+        for f in failures:
+            print("FAIL", f, file=sys.stderr)
+        return 1
+    print(f"OK: all {len(GOLDEN_LOGITS)} logits within atol={args.atol}, top-1 pants")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,14 @@ def build_sequence_parallel_forward(
         raise ValueError(
             f"sequence parallelism needs a vit family, got {spec.family!r}"
         )
+    from kubernetes_deep_learning_tpu.parallel.mesh import MODEL_AXIS
+
+    if MODEL_AXIS in mesh.shape and mesh.shape[MODEL_AXIS] > 1:
+        raise ValueError(
+            "sequence parallelism uses the data axis only; a model-parallel "
+            f"mesh axis of {mesh.shape[MODEL_AXIS]} would duplicate every "
+            "token shard -- use model_parallel=1"
+        )
     h, w = spec.input_shape[:2]
     seq = (h // cfg.patch) * (w // cfg.patch)
     n = mesh.shape[axis_name]

@@ -69,7 +69,10 @@ class InferenceEngine:
             buckets = sorted({-(-b // n_data) * n_data for b in buckets})
         self.buckets = tuple(sorted(buckets))
         self.max_batch = self.buckets[-1]
-        self._device = device or jax.devices()[0]
+        # local_devices, not devices: after jax.distributed.initialize the
+        # global list includes other hosts' chips, which this process cannot
+        # device_put to -- each serving process drives its own chips.
+        self._device = device or jax.local_devices()[0]
         self._lock = threading.Lock()
         self._ready = threading.Event()
 
@@ -82,14 +85,19 @@ class InferenceEngine:
             import jax.numpy as jnp
 
             if mesh_mode == "sequence":
-                from kubernetes_deep_learning_tpu.parallel.dataparallel import (
-                    shard_variables,
-                )
+                from jax.sharding import NamedSharding, PartitionSpec
+
                 from kubernetes_deep_learning_tpu.parallel.longseq import (
                     build_sequence_parallel_forward,
                 )
 
-                self._variables = shard_variables(artifact.variables, mesh)
+                # longseq declares params replicated (P()); sharding them on
+                # the model axis here would just force an all-gather per
+                # dispatch (and build_sequence_parallel_forward rejects
+                # model-parallel meshes outright).
+                self._variables = jax.device_put(
+                    artifact.variables, NamedSharding(mesh, PartitionSpec())
+                )
                 sharded_call = build_sequence_parallel_forward(
                     self.spec, mesh, dtype=jnp.dtype(self._compute_dtype)
                 )

@@ -448,8 +448,9 @@ def main(argv: list[str] | None = None) -> int:
         "--data-parallel",
         type=int,
         default=0,
-        help="serve data-parallel over this many local chips (0 = one device); "
-        "the batch is sharded over a jax Mesh, XLA replicates params over ICI",
+        help="serve over a mesh of this many LOCAL chips total (0 = one "
+        "device); with --model-parallel M the mesh is (N/M data, M model), "
+        "so the batch is sharded N/M ways",
     )
     p.add_argument(
         "--parallel-mode",
@@ -457,6 +458,13 @@ def main(argv: list[str] | None = None) -> int:
         choices=["data", "sequence"],
         help="with --data-parallel: shard the batch (data) or the token "
         "sequence via ring attention (sequence; vit families only)",
+    )
+    p.add_argument(
+        "--model-parallel",
+        type=int,
+        default=1,
+        help="with --data-parallel: devices per tensor-parallel group on the "
+        "mesh's inner (fastest-ICI) axis; wide kernels shard their output dim",
     )
     p.add_argument(
         "--profile-dir",
@@ -486,11 +494,31 @@ def main(argv: list[str] | None = None) -> int:
 
     force_platform(args.platform)
 
+    from kubernetes_deep_learning_tpu.utils.distributed import initialize
+
+    if initialize():
+        import jax
+
+        print(
+            f"multi-host runtime: process {jax.process_index()} of "
+            f"{jax.process_count()}, {len(jax.devices())} global devices"
+        )
+
     mesh = None
     if args.data_parallel > 0:
+        import jax
+
         from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(args.data_parallel)
+        # LOCAL devices only: the per-request HTTP serving model cannot
+        # drive a cross-host SPMD program (every process would have to
+        # enter the same dispatch in lockstep with the same data).  Scaling
+        # across hosts is replica scaling, the reference's own mechanism.
+        mesh = make_mesh(
+            args.data_parallel,
+            model_parallel=args.model_parallel,
+            devices=jax.local_devices(),
+        )
 
     server = ModelServer(
         args.models,

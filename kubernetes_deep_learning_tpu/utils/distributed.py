@@ -1,0 +1,82 @@
+"""Multi-host runtime initialization: the DCN half of the comm backend.
+
+The reference's inter-node "backend" is gRPC between k8s pods (SURVEY.md
+section 2); it never coordinates accelerators across hosts.  This framework's
+collectives ride ICI within a slice (parallel/), and spanning *hosts* needs
+exactly one extra step: ``jax.distributed.initialize`` so every process joins
+one global runtime -- after which jax.devices() is the whole pod slice, a
+Mesh built over it spans hosts, and XLA routes collectives over ICI within a
+slice and DCN between slices.  This module wraps that step with the env
+conventions of the deployment targets:
+
+- **GKE TPU pod slices** (deploy/): the TPU runtime carries its own
+  coordinator/topology metadata, so a bare initialize() with no arguments is
+  correct -- every worker of a multi-host node pool auto-discovers.
+- **Manual / CPU-fleet bring-up** (tests, dev boxes): coordinates through
+  ``KDLT_COORDINATOR`` (host:port of process 0), ``KDLT_NUM_PROCESSES``, and
+  ``KDLT_PROCESS_ID``, mirroring the reference's pattern of wiring tiers
+  together by env var (reference serving-gateway-deployment.yaml:22-24).
+"""
+
+from __future__ import annotations
+
+import os
+
+COORDINATOR_ENV = "KDLT_COORDINATOR"
+NUM_PROCESSES_ENV = "KDLT_NUM_PROCESSES"
+PROCESS_ID_ENV = "KDLT_PROCESS_ID"
+
+
+def env_spec(environ=None) -> dict | None:
+    """Parse the manual-coordination env triplet; None when unset.
+
+    All three must be present together -- a partial spec is a deployment
+    bug, surfaced loudly rather than half-initializing.
+    """
+    environ = os.environ if environ is None else environ
+    keys = (COORDINATOR_ENV, NUM_PROCESSES_ENV, PROCESS_ID_ENV)
+    present = [k for k in keys if k in environ]
+    if not present:
+        return None
+    if len(present) != len(keys):
+        missing = sorted(set(keys) - set(present))
+        raise ValueError(f"partial multi-host env: missing {missing}")
+    num = int(environ[NUM_PROCESSES_ENV])
+    pid = int(environ[PROCESS_ID_ENV])
+    if num <= 0 or not 0 <= pid < num:
+        raise ValueError(
+            f"invalid multi-host env: num_processes={num}, process_id={pid}"
+        )
+    return {
+        "coordinator_address": environ[COORDINATOR_ENV],
+        "num_processes": num,
+        "process_id": pid,
+    }
+
+
+def initialize(environ=None) -> bool:
+    """Join the global runtime if this looks like a multi-host deployment.
+
+    Returns True when jax.distributed.initialize ran.  Order matters: call
+    before the first jax.devices()/backend touch (same constraint as
+    utils.platform.force_platform).  Safe to call in single-process runs --
+    with no env spec and no TPU pod metadata requirement, it is a no-op.
+    """
+    environ = os.environ if environ is None else environ
+    spec = env_spec(environ)
+    if spec is not None:
+        import jax
+
+        jax.distributed.initialize(**spec)
+        return True
+    # On a multi-host TPU slice the runtime self-coordinates; initialize()
+    # with no args is required there and harmless to skip elsewhere.  The
+    # TPU case is recognizable by the platform env / plugin, but only the
+    # operator knows intent on shared dev boxes -- so auto-run only when
+    # explicitly requested.
+    if environ.get("KDLT_MULTIHOST", "") == "1":
+        import jax
+
+        jax.distributed.initialize()
+        return True
+    return False

@@ -1,0 +1,60 @@
+"""utils.distributed env parsing + golden-logit checker logic."""
+
+import pytest
+
+from kubernetes_deep_learning_tpu.golden import GOLDEN_LOGITS, check_scores
+from kubernetes_deep_learning_tpu.utils import distributed as dist
+
+
+def test_env_spec_absent():
+    assert dist.env_spec({}) is None
+
+
+def test_env_spec_complete():
+    spec = dist.env_spec({
+        dist.COORDINATOR_ENV: "10.0.0.1:1234",
+        dist.NUM_PROCESSES_ENV: "4",
+        dist.PROCESS_ID_ENV: "2",
+    })
+    assert spec == {
+        "coordinator_address": "10.0.0.1:1234",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+
+
+def test_env_spec_partial_is_loud():
+    with pytest.raises(ValueError, match="missing"):
+        dist.env_spec({dist.COORDINATOR_ENV: "10.0.0.1:1234"})
+
+
+@pytest.mark.parametrize("num,pid", [("0", "0"), ("4", "4"), ("4", "-1")])
+def test_env_spec_invalid_ranges(num, pid):
+    with pytest.raises(ValueError, match="invalid"):
+        dist.env_spec({
+            dist.COORDINATOR_ENV: "a:1",
+            dist.NUM_PROCESSES_ENV: num,
+            dist.PROCESS_ID_ENV: pid,
+        })
+
+
+def test_initialize_noop_without_env():
+    assert dist.initialize({}) is False
+
+
+def test_golden_check_passes_on_exact():
+    assert check_scores(dict(GOLDEN_LOGITS), atol=0.01) == []
+
+
+def test_golden_check_flags_drift_and_top1():
+    scores = dict(GOLDEN_LOGITS)
+    scores["pants"] = -10.0  # drifted AND no longer top-1
+    failures = check_scores(scores, atol=0.05)
+    assert any("pants: got" in f for f in failures)
+    assert any("top-1" in f for f in failures)
+
+
+def test_golden_check_flags_missing_label():
+    scores = dict(GOLDEN_LOGITS)
+    del scores["hat"]
+    assert any("hat: missing" in f for f in check_scores(scores, atol=0.05))

@@ -100,3 +100,5 @@ def test_rejects_non_vit_and_indivisible(ls_spec):
     )
     with pytest.raises(ValueError, match="not divisible"):
         build_sequence_parallel_forward(odd, mesh)
+    with pytest.raises(ValueError, match="model_parallel=1"):
+        build_sequence_parallel_forward(ls_spec, make_mesh(8, model_parallel=2))
