@@ -16,12 +16,18 @@ FROM python:3.11-slim
 ENV PYTHONUNBUFFERED=TRUE
 
 WORKDIR /app
-COPY pyproject.toml ./
+COPY pyproject.toml constraints.txt ./
 COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
-RUN pip install --no-cache-dir .
+# constraints.txt pins exact versions (the reference's Pipfile.lock role).
+# .[serve] adds gunicorn so either entrypoint below works.
+RUN pip install --no-cache-dir -c constraints.txt ".[serve]"
 
 EXPOSE 9696
 # Model-tier discovery via KDLT_SERVING_HOST (k8s DNS), localhost fallback for
 # docker-compose style local runs -- the reference's TF_SERVING_HOST pattern
 # (reference model_server.py:13, serving-gateway-deployment.yaml:22-24).
 ENTRYPOINT ["kdlt-gateway", "--port", "9696"]
+# gunicorn posture (the reference's exact production server,
+# gateway.dockerfile:16) is available instead via serving/wsgi.py:
+#   ENTRYPOINT ["gunicorn", "-w", "4", "-b", "0.0.0.0:9696", \
+#               "kubernetes_deep_learning_tpu.serving.wsgi:app"]

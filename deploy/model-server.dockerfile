@@ -17,13 +17,19 @@ FROM python:3.11-slim
 
 ENV PYTHONUNBUFFERED=TRUE
 
-RUN pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || \
-    pip install --no-cache-dir jax
+# Constrained from the very first resolve: an unpinned jax[tpu] here would
+# pull a libtpu matched to a NEWER jaxlib than the pinned one installed
+# below, and the stale PJRT plugin fails at runtime on the TPU node.
+COPY constraints.txt /tmp/constraints.txt
+RUN pip install --no-cache-dir -c /tmp/constraints.txt "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || \
+    pip install --no-cache-dir -c /tmp/constraints.txt jax
 
 WORKDIR /app
-COPY pyproject.toml ./
+COPY pyproject.toml constraints.txt ./
 COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
-RUN pip install --no-cache-dir .
+# constraints.txt pins exact versions (the reference's Pipfile.lock role).
+RUN pip install --no-cache-dir -c constraints.txt .
 
 # Versioned artifact layout /models/<name>/<version>/ -- the same convention
 # the reference bakes its SavedModel with (tf-serving.dockerfile:5).

@@ -58,7 +58,11 @@ class Gateway:
         model: str | None = None,
         port: int = DEFAULT_PORT,
         host: str = "0.0.0.0",
+        bind: bool = True,
     ):
+        # bind=False skips the in-tree HTTP server entirely: serving.wsgi
+        # wraps this object under an external WSGI server (gunicorn) instead,
+        # the reference's production-server arrangement.
         self.serving_host = serving_host or os.environ.get(
             SERVING_HOST_ENV, DEFAULT_SERVING_HOST
         )
@@ -79,9 +83,12 @@ class Gateway:
             "kdlt_gateway_fetch_seconds", "image download+decode+resize latency"
         )
 
-        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
-        self._httpd.daemon_threads = True
-        self.port = self._httpd.server_address[1]
+        self._httpd = None
+        self.port = port
+        if bind:
+            self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+            self._httpd.daemon_threads = True
+            self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
     # --- model-server client ----------------------------------------------
@@ -161,6 +168,44 @@ class Gateway:
             raise UpstreamError(f"malformed model server response: {e}") from e
         return dict(zip(labels, map(float, logits[0])))
 
+    # --- transport-neutral request handling --------------------------------
+    # One implementation of routing, error mapping, and metrics policy,
+    # shared by the in-tree threaded server below and serving.wsgi (gunicorn)
+    # so the two deployment postures can never diverge.
+
+    def handle_get(self, path: str) -> tuple[int, bytes, str]:
+        """Route a GET; returns (status, body, content_type)."""
+        if path == "/healthz":
+            return 200, b"ok", "text/plain"
+        if path == "/readyz":
+            try:
+                self.spec  # reachable + spec discoverable => ready
+                return 200, b"ready", "text/plain"
+            except Exception as e:
+                return 503, str(e).encode(), "text/plain"
+        if path == "/metrics":
+            return 200, self.registry.render().encode(), "text/plain"
+        return 404, b'{"error": "not found"}', "application/json"
+
+    def handle_predict(self, body: bytes) -> tuple[int, bytes, str]:
+        """POST /predict body -> (status, body, content_type), instrumented."""
+        t0 = time.perf_counter()
+        self._m_requests.inc()
+        try:
+            req = json.loads(body)
+            scores = self.apply_model(req["url"])
+            return 200, json.dumps(scores).encode(), "application/json"
+        except UpstreamError as e:
+            self._m_errors.inc()
+            return e.http_status, json.dumps({"error": str(e)}).encode(), "application/json"
+        except Exception as e:
+            # Bad JSON, missing "url", unfetchable/undecodable image:
+            # genuinely the caller's fault.
+            self._m_errors.inc()
+            return 400, json.dumps({"error": str(e)}).encode(), "application/json"
+        finally:
+            self._m_latency.observe(time.perf_counter() - t0)
+
     # --- HTTP plumbing ----------------------------------------------------
 
     def _make_handler(self):
@@ -172,7 +217,7 @@ class Gateway:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+            def _send(self, code: int, body: bytes, ctype: str):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -180,43 +225,19 @@ class Gateway:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    return self._send(200, b"ok", "text/plain")
-                if self.path == "/readyz":
-                    try:
-                        gw.spec  # reachable + spec discoverable => ready
-                        return self._send(200, b"ready", "text/plain")
-                    except Exception as e:
-                        return self._send(503, str(e).encode(), "text/plain")
-                if self.path == "/metrics":
-                    return self._send(200, gw.registry.render().encode(), "text/plain")
-                self._send(404, b'{"error": "not found"}')
+                self._send(*gw.handle_get(self.path))
 
             def do_POST(self):
                 if self.path != "/predict":
-                    return self._send(404, b'{"error": "not found"}')
-                t0 = time.perf_counter()
-                gw._m_requests.inc()
-                try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length))
-                    url = req["url"]
-                    scores = gw.apply_model(url)
-                    self._send(200, json.dumps(scores).encode())
-                except UpstreamError as e:
-                    gw._m_errors.inc()
-                    self._send(e.http_status, json.dumps({"error": str(e)}).encode())
-                except Exception as e:
-                    # Bad JSON, missing "url", unfetchable/undecodable image:
-                    # genuinely the caller's fault.
-                    gw._m_errors.inc()
-                    self._send(400, json.dumps({"error": str(e)}).encode())
-                finally:
-                    gw._m_latency.observe(time.perf_counter() - t0)
+                    return self._send(404, b'{"error": "not found"}', "application/json")
+                length = int(self.headers.get("Content-Length", 0))
+                self._send(*gw.handle_predict(self.rfile.read(length)))
 
         return Handler
 
     def start(self, block: bool = False) -> None:
+        if self._httpd is None:
+            raise RuntimeError("gateway built with bind=False; serve it via WSGI")
         self._serving = True
         if block:
             self._httpd.serve_forever()
@@ -227,6 +248,8 @@ class Gateway:
             self._thread.start()
 
     def shutdown(self) -> None:
+        if self._httpd is None:
+            return
         # See ModelServer.shutdown: BaseServer.shutdown() hangs if
         # serve_forever never ran.
         if getattr(self, "_serving", False):

@@ -1,0 +1,76 @@
+"""WSGI adapter: the gateway under gunicorn (reference parity).
+
+The reference's production arrangement is gunicorn driving a WSGI app
+(reference gateway.dockerfile:16, ``gunicorn model_server:app``).  The
+in-tree default here is the threaded stdlib server (see
+deploy/gateway.dockerfile for why threads suit a pure-IO gateway), but
+operators who want gunicorn's pre-fork process model -- worker recycling,
+graceful reloads, the exact reference posture -- get it via this module:
+
+    pip install .[serve]
+    gunicorn 'kubernetes_deep_learning_tpu.serving.wsgi:app'
+
+Configuration comes from the same env vars as the CLI (KDLT_SERVING_HOST,
+KDLT_MODEL); each gunicorn worker process builds its own Gateway (own
+upstream connection pool), mirroring the reference's per-worker module
+globals (reference model_server.py:13-18).  Routing, error mapping, and
+metrics live on Gateway.handle_get/handle_predict -- this module is pure
+transport translation, so the two server postures cannot diverge.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+
+_STATUS = {
+    200: "200 OK",
+    400: "400 Bad Request",
+    404: "404 Not Found",
+    502: "502 Bad Gateway",
+    503: "503 Service Unavailable",
+}
+
+
+class GatewayWSGI:
+    """WSGI callable exposing the gateway's routes."""
+
+    def __init__(self, gateway: Gateway | None = None):
+        self.gateway = gateway or Gateway(bind=False)
+
+    def __call__(self, environ: dict, start_response: Callable) -> Iterable[bytes]:
+        method = environ.get("REQUEST_METHOD", "GET")
+        path = environ.get("PATH_INFO", "/")
+        if method == "GET":
+            code, body, ctype = self.gateway.handle_get(path)
+        elif method == "POST" and path == "/predict":
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+            code, body, ctype = self.gateway.handle_predict(
+                environ["wsgi.input"].read(length)
+            )
+        else:
+            code, body, ctype = 404, b'{"error": "not found"}', "application/json"
+        start_response(
+            _STATUS.get(code, f"{code} Error"),
+            [("Content-Type", ctype), ("Content-Length", str(len(body)))],
+        )
+        return [body]
+
+
+# The module-level app gunicorn imports; built lazily (so importing this
+# module does not yet require the model tier) and under a lock (threaded
+# workers could otherwise race two Gateways into existence on first load,
+# splitting the metrics registry).
+_app_instance: GatewayWSGI | None = None
+_app_lock = threading.Lock()
+
+
+def app(environ, start_response):
+    global _app_instance
+    if _app_instance is None:
+        with _app_lock:
+            if _app_instance is None:
+                _app_instance = GatewayWSGI()
+    return _app_instance(environ, start_response)

@@ -1,0 +1,98 @@
+"""serving.wsgi: the gateway under a real WSGI server (gunicorn posture)."""
+
+import json
+import threading
+import wsgiref.simple_server
+
+import numpy as np
+import pytest
+import requests
+
+from kubernetes_deep_learning_tpu.export.exporter import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+from kubernetes_deep_learning_tpu.serving.wsgi import GatewayWSGI
+
+
+@pytest.fixture(scope="module")
+def wsgi_stack(tmp_path_factory):
+    spec = register_spec(
+        ModelSpec(
+            name="wsgi-vit",
+            family="vit-tiny",
+            input_shape=(16, 16, 3),
+            labels=("a", "b"),
+            preprocessing="tf",
+        )
+    )
+    root = tmp_path_factory.mktemp("wsgi-models")
+    export_model(spec, init_variables(spec, seed=0), str(root))
+    server = ModelServer(str(root), port=0, buckets=(1, 2))
+    server.warmup()
+    server.start()
+
+    gw = Gateway(serving_host=f"localhost:{server.port}", model="wsgi-vit", bind=False)
+    wsgi = wsgiref.simple_server.make_server(
+        "127.0.0.1", 0, GatewayWSGI(gw),
+        handler_class=wsgiref.simple_server.WSGIRequestHandler,
+    )
+    threading.Thread(target=wsgi.serve_forever, daemon=True).start()
+
+    # A local image to fetch (no egress in tests).
+    import http.server, functools, io
+    from PIL import Image
+
+    webroot = tmp_path_factory.mktemp("wsgi-web")
+    img = Image.fromarray(
+        np.random.default_rng(0).integers(0, 255, (20, 24, 3), dtype=np.uint8), "RGB"
+    )
+    img.save(webroot / "x.png")
+    fileserver = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", 0),
+        functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=str(webroot)
+        ),
+    )
+    threading.Thread(target=fileserver.serve_forever, daemon=True).start()
+
+    yield {
+        "base": f"http://127.0.0.1:{wsgi.server_address[1]}",
+        "image_url": f"http://127.0.0.1:{fileserver.server_address[1]}/x.png",
+    }
+    wsgi.shutdown()
+    fileserver.shutdown()
+    server.shutdown()
+
+
+def test_wsgi_predict_roundtrip(wsgi_stack):
+    r = requests.post(
+        wsgi_stack["base"] + "/predict",
+        json={"url": wsgi_stack["image_url"]},
+        timeout=30,
+    )
+    assert r.status_code == 200, r.text
+    scores = r.json()
+    assert set(scores) == {"a", "b"}
+    assert all(np.isfinite(v) for v in scores.values())
+
+
+def test_wsgi_health_metrics_and_errors(wsgi_stack):
+    base = wsgi_stack["base"]
+    assert requests.get(base + "/healthz", timeout=10).status_code == 200
+    assert requests.get(base + "/readyz", timeout=10).status_code == 200
+    m = requests.get(base + "/metrics", timeout=10)
+    assert "kdlt_gateway_requests_total" in m.text
+    assert requests.get(base + "/nope", timeout=10).status_code == 404
+    r = requests.post(base + "/predict", data=b"not json", timeout=10)
+    assert r.status_code == 400
+    assert "error" in r.json()
+
+
+def test_bind_false_has_no_listener():
+    gw = Gateway(bind=False)
+    assert gw._httpd is None
+    with pytest.raises(RuntimeError, match="bind=False"):
+        gw.start()
+    gw.shutdown()  # no-op, must not raise
