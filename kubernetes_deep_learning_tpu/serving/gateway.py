@@ -41,6 +41,11 @@ SERVING_HOST_ENV = "KDLT_SERVING_HOST"
 MODEL_ENV = "KDLT_MODEL"
 DEFAULT_MODEL = "clothing-model"
 PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
+UPSTREAM_RETRY_BACKOFF_S = 0.05  # one retry on the model tier's 503 overload
+MAX_BATCH_FETCHERS = 8       # concurrent image downloads per batch request
+MAX_URLS_PER_REQUEST = 256   # hard cap: bounds per-request image memory
+UPSTREAM_CHUNK = 32          # images per model-tier predict; stays within the
+                             # engine's default bucket ladder (max 128)
 
 
 class UpstreamError(RuntimeError):
@@ -128,9 +133,8 @@ class Gateway:
                     self._spec = ModelSpec.from_json(r.text)
         return self._spec
 
-    def apply_model(self, url: str) -> dict[str, float]:
-        """url -> {label: score}; the reference's apply_model
-        (reference model_server.py:52-56)."""
+    def _fetch_one(self, url: str):
+        """url -> resized uint8 HWC image (host-side half of the pipeline)."""
         spec = self.spec
         t0 = time.perf_counter()
         data = preprocess.fetch_image_bytes(url)
@@ -138,22 +142,35 @@ class Gateway:
             data, spec.input_shape[:2], filter=spec.resize_filter
         )
         self._m_fetch.observe(time.perf_counter() - t0)
+        return image
 
+    def _predict_batch(self, images) -> tuple[list, list[str]]:
+        """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
+
+        One retry on 503: that status is the model tier's explicit transient
+        overload signal (batcher QueueFull), so a brief backoff usually
+        succeeds and spares the client a round trip; anything else fails
+        straight through.
+        """
         import requests
 
-        body = protocol.encode_predict_request(image[None])
-        try:
-            r = self._session().post(
-                f"{self._base}/v1/models/{self.model}:predict",
-                data=body,
-                headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
-                timeout=PREDICT_TIMEOUT_S,
-            )
-        except requests.RequestException as e:
-            raise UpstreamError(f"model server unreachable: {e}") from e
+        body = protocol.encode_predict_request(images)
+        r = None
+        for attempt in (0, 1):
+            if attempt:
+                time.sleep(UPSTREAM_RETRY_BACKOFF_S)
+            try:
+                r = self._session().post(
+                    f"{self._base}/v1/models/{self.model}:predict",
+                    data=body,
+                    headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+                    timeout=PREDICT_TIMEOUT_S,
+                )
+            except requests.RequestException as e:
+                raise UpstreamError(f"model server unreachable: {e}") from e
+            if r.status_code != 503:
+                break
         if r.status_code != 200:
-            # Pass through the model tier's own overload signal (503 from the
-            # batcher's QueueFull) as retryable; other failures are 502.
             status = 503 if r.status_code == 503 else 502
             raise UpstreamError(
                 f"model server error {r.status_code}: {r.text[:200]}", status
@@ -166,7 +183,56 @@ class Gateway:
             # A 200 with an undecodable body is the model tier's fault
             # (truncated response, content-type mismatch), never the client's.
             raise UpstreamError(f"malformed model server response: {e}") from e
+        return logits, labels
+
+    def apply_model(self, url: str) -> dict[str, float]:
+        """url -> {label: score}; the reference's apply_model
+        (reference model_server.py:52-56)."""
+        image = self._fetch_one(url)
+        logits, labels = self._predict_batch(image[None])
         return dict(zip(labels, map(float, logits[0])))
+
+    def apply_model_batch(self, urls: list[str]) -> list[dict]:
+        """urls -> per-url {label: score} or {"error": ...}, order-preserving.
+
+        Beyond-reference extension: fetches run concurrently (IO-bound) and
+        successfully fetched images travel to the model tier in chunks of
+        UPSTREAM_CHUNK (within the engine's bucket ladder), so it sees full
+        batches instead of n racing singles.  A bad URL fails only its own
+        entry; a model-tier failure fails the whole request (UpstreamError
+        propagates -- it is not a per-URL condition).
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        if not urls:
+            return []
+        if len(urls) > MAX_URLS_PER_REQUEST:
+            raise ValueError(
+                f"{len(urls)} urls exceeds the {MAX_URLS_PER_REQUEST}-url limit"
+            )
+        self.spec  # discover upstream contract FIRST: outage => 502, not 200
+        with ThreadPoolExecutor(max_workers=min(len(urls), MAX_BATCH_FETCHERS)) as ex:
+            fetched = list(ex.map(self._fetch_one_safe, urls))
+        good = [(i, img) for i, (img, _) in enumerate(fetched) if img is not None]
+        results: list[dict] = [
+            {"error": err} if err is not None else {} for _, err in fetched
+        ]
+        import numpy as np
+
+        for start in range(0, len(good), UPSTREAM_CHUNK):
+            chunk = good[start : start + UPSTREAM_CHUNK]
+            logits, labels = self._predict_batch(np.stack([img for _, img in chunk]))
+            for row, (i, _) in enumerate(chunk):
+                results[i] = dict(zip(labels, map(float, logits[row])))
+        return results
+
+    def _fetch_one_safe(self, url: str):
+        try:
+            return self._fetch_one(url), None
+        except UpstreamError:
+            raise  # model-tier trouble is the request's failure, not the URL's
+        except Exception as e:
+            return None, str(e)
 
     # --- transport-neutral request handling --------------------------------
     # One implementation of routing, error mapping, and metrics policy,
@@ -193,6 +259,10 @@ class Gateway:
         self._m_requests.inc()
         try:
             req = json.loads(body)
+            if "urls" in req:  # batch extension; {"url": ...} is the
+                # reference's schema (reference test.py:15) and unchanged
+                preds = self.apply_model_batch(list(req["urls"]))
+                return 200, json.dumps({"predictions": preds}).encode(), "application/json"
             scores = self.apply_model(req["url"])
             return 200, json.dumps(scores).encode(), "application/json"
         except UpstreamError as e:

@@ -57,6 +57,7 @@ _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
 _MODEL_RE = re.compile(r"^/v1/models/([^/:]+)$")
 
 DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-model-service.yaml:9-10)
+MAX_IMAGES_PER_REQUEST = 2048  # bounds one request's decoded-image memory
 
 
 class ServedModel:
@@ -113,7 +114,18 @@ class ServedModel:
                 # still valid, so the in-flight request must not become
                 # a client-visible 500.
                 pass
-        return self.engine.predict(images)
+        max_b = self.engine.max_batch
+        if images.shape[0] <= max_b:
+            return self.engine.predict(images)
+        # Batches beyond the bucket ladder are served in max-bucket chunks
+        # rather than erroring: the client's batch size should not have to
+        # know this server's compiled shapes.
+        return np.concatenate(
+            [
+                self.engine.predict(images[i : i + max_b])
+                for i in range(0, images.shape[0], max_b)
+            ]
+        )
 
     def close(self) -> None:
         if self.batcher is not None:
@@ -341,6 +353,11 @@ class ModelServer:
                     if images.shape[1:] != spec.input_shape:
                         raise ValueError(
                             f"input shape {images.shape[1:]} != {spec.input_shape}"
+                        )
+                    if images.shape[0] > MAX_IMAGES_PER_REQUEST:
+                        raise ValueError(
+                            f"batch {images.shape[0]} exceeds the "
+                            f"{MAX_IMAGES_PER_REQUEST}-image request limit"
                         )
                     logits = model.predict(images)
                     out, out_ctype = protocol.encode_predict_response(

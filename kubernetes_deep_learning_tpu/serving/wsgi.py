@@ -20,18 +20,15 @@ transport translation, so the two server postures cannot diverge.
 
 from __future__ import annotations
 
+import http.client
 import threading
 from typing import Callable, Iterable
 
 from kubernetes_deep_learning_tpu.serving.gateway import Gateway
 
-_STATUS = {
-    200: "200 OK",
-    400: "400 Bad Request",
-    404: "404 Not Found",
-    502: "502 Bad Gateway",
-    503: "503 Service Unavailable",
-}
+
+def _status_line(code: int) -> str:
+    return f"{code} {http.client.responses.get(code, 'Error')}"
 
 
 class GatewayWSGI:
@@ -53,7 +50,7 @@ class GatewayWSGI:
         else:
             code, body, ctype = 404, b'{"error": "not found"}', "application/json"
         start_response(
-            _STATUS.get(code, f"{code} Error"),
+            _status_line(code),
             [("Content-Type", ctype), ("Content-Length", str(len(body)))],
         )
         return [body]

@@ -193,3 +193,91 @@ def test_concurrent_gateway_requests(stack):
     for r in results[1:]:
         for label in first:
             assert abs(r[label] - first[label]) < 5e-3, (label, r, first)
+
+
+def test_gateway_batch_urls(stack):
+    # Beyond-reference extension: {"urls": [...]} -> {"predictions": [...]},
+    # order preserved, one bad URL failing only its own entry.
+    import requests
+
+    spec, _, gateway, image_url, _, _ = stack
+    bad_url = image_url.replace("pants.png", "missing.png")
+    r = requests.post(
+        f"http://localhost:{gateway.port}/predict",
+        json={"urls": [image_url, bad_url, image_url]},
+        timeout=30,
+    )
+    assert r.status_code == 200, r.text
+    preds = r.json()["predictions"]
+    assert len(preds) == 3
+    assert set(preds[0]) == set(spec.labels)
+    assert "error" in preds[1]
+    assert preds[2] == preds[0]
+
+
+def test_gateway_retries_transient_503(stack, monkeypatch):
+    # First upstream response is the model tier's overload signal; the
+    # gateway must retry once and succeed rather than surface the 503.
+    spec, _, gateway, image_url, _, _ = stack
+    real_post = gateway._session().post
+    calls = {"n": 0}
+
+    class Fake503:
+        status_code = 503
+        text = "overloaded"
+
+    def flaky_post(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return Fake503()
+        return real_post(*args, **kwargs)
+
+    monkeypatch.setattr(gateway._session(), "post", flaky_post)
+    scores = gateway.apply_model(image_url)
+    assert set(scores) == set(spec.labels)
+    assert calls["n"] == 2
+
+
+def test_oversized_batch_is_chunked_not_rejected(stack):
+    # The e2e stack's buckets stop at 4; a 10-image request must be served
+    # in bucket-sized chunks, not bounced with "exceeds max bucket".
+    spec, server, _, _, pixels, _ = stack
+    from kubernetes_deep_learning_tpu.ops.preprocess import resize_uint8
+
+    img = resize_uint8(pixels, spec.input_shape[:2], filter=spec.resize_filter)
+    batch = np.stack([img] * 10)
+    logits, labels = predict_images(
+        f"http://localhost:{server.port}", spec.name, batch
+    )
+    assert logits.shape == (10, spec.num_classes)
+    # Identical inputs, identical rows (chunk boundaries must not matter).
+    np.testing.assert_allclose(logits, np.tile(logits[:1], (10, 1)), atol=1e-5)
+
+
+def test_gateway_batch_larger_than_tier_buckets(stack):
+    import requests
+
+    spec, _, gateway, image_url, _, _ = stack
+    r = requests.post(
+        f"http://localhost:{gateway.port}/predict",
+        json={"urls": [image_url] * 6},  # > the tier's max bucket of 4
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+    preds = r.json()["predictions"]
+    assert len(preds) == 6 and all(set(p) == set(spec.labels) for p in preds)
+
+
+def test_gateway_batch_url_cap(stack):
+    import requests
+
+    from kubernetes_deep_learning_tpu.serving import gateway as gw_mod
+
+    _, _, gateway, image_url, _, _ = stack
+    r = requests.post(
+        f"http://localhost:{gateway.port}/predict",
+        json={"urls": [image_url] * (gw_mod.MAX_URLS_PER_REQUEST + 1)},
+        timeout=60,
+    )
+    assert r.status_code == 400
+    assert "limit" in r.json()["error"]
