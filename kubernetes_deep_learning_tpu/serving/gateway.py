@@ -44,8 +44,6 @@ PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
 UPSTREAM_RETRY_BACKOFF_S = 0.05  # one retry on the model tier's 503 overload
 MAX_BATCH_FETCHERS = 8       # concurrent image downloads per batch request
 MAX_URLS_PER_REQUEST = 256   # hard cap: bounds per-request image memory
-UPSTREAM_CHUNK = 32          # images per model-tier predict; stays within the
-                             # engine's default bucket ladder (max 128)
 
 
 class UpstreamError(RuntimeError):
@@ -196,11 +194,11 @@ class Gateway:
         """urls -> per-url {label: score} or {"error": ...}, order-preserving.
 
         Beyond-reference extension: fetches run concurrently (IO-bound) and
-        successfully fetched images travel to the model tier in chunks of
-        UPSTREAM_CHUNK (within the engine's bucket ladder), so it sees full
-        batches instead of n racing singles.  A bad URL fails only its own
-        entry; a model-tier failure fails the whole request (UpstreamError
-        propagates -- it is not a per-URL condition).
+        every successfully fetched image travels to the model tier as ONE
+        predict (the tier splits oversize batches over its own bucket
+        ladder, ServedModel.predict -- chunking policy lives in one place).
+        A bad URL fails only its own entry; a model-tier failure fails the
+        whole request (UpstreamError propagates, not a per-URL condition).
         """
         from concurrent.futures import ThreadPoolExecutor
 
@@ -217,12 +215,11 @@ class Gateway:
         results: list[dict] = [
             {"error": err} if err is not None else {} for _, err in fetched
         ]
-        import numpy as np
+        if good:
+            import numpy as np
 
-        for start in range(0, len(good), UPSTREAM_CHUNK):
-            chunk = good[start : start + UPSTREAM_CHUNK]
-            logits, labels = self._predict_batch(np.stack([img for _, img in chunk]))
-            for row, (i, _) in enumerate(chunk):
+            logits, labels = self._predict_batch(np.stack([img for _, img in good]))
+            for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
         return results
 

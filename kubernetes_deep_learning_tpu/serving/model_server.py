@@ -344,10 +344,24 @@ class ModelServer:
                     return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
                 try:
                     length = int(self.headers.get("Content-Length", 0))
+                    spec = model.artifact.spec
+                    # Enforce the batch bound BEFORE reading/decoding: a cap
+                    # checked after np-materializing the body would not bound
+                    # memory at all.  uint8 wire bytes ~= pixels; 2x covers
+                    # JSON's decimal encoding overhead per float32 pixel.
+                    limit = (
+                        MAX_IMAGES_PER_REQUEST * int(np.prod(spec.input_shape)) * 8
+                        + 1_048_576
+                    )
+                    if length > limit:
+                        raise ValueError(
+                            f"request body {length} bytes exceeds the "
+                            f"{limit}-byte limit "
+                            f"({MAX_IMAGES_PER_REQUEST}-image cap)"
+                        )
                     body = self.rfile.read(length)
                     ctype = self.headers.get("Content-Type", "")
                     images = protocol.decode_predict_request(body, ctype)
-                    spec = model.artifact.spec
                     if images.ndim == 3:
                         images = images[None]
                     if images.shape[1:] != spec.input_shape:

@@ -281,3 +281,25 @@ def test_gateway_batch_url_cap(stack):
     )
     assert r.status_code == 400
     assert "limit" in r.json()["error"]
+
+
+def test_request_byte_limit_precedes_read(stack):
+    # The server must reject an oversized Content-Length BEFORE reading or
+    # decoding the body (the cap is a memory bound, not a shape check) --
+    # it answers 400 while the client has sent no body bytes at all.
+    # Raw http.client: requests would overwrite a forged Content-Length.
+    import http.client
+
+    spec, server, _, _, _, _ = stack
+    conn = http.client.HTTPConnection("localhost", server.port, timeout=30)
+    try:
+        conn.putrequest("POST", f"/v1/models/{spec.name}:predict")
+        conn.putheader("Content-Type", "application/json")
+        conn.putheader("Content-Length", str(64 * 1024**3))
+        conn.endheaders()
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 400
+        assert "limit" in body["error"]
+    finally:
+        conn.close()
