@@ -13,14 +13,14 @@ TPU-first choices:
   (H/p * W/p), a multiple of the flash kernel's 128-wide MXU tiles for the
   registered input sizes; a cls token would make S=197-style primes and force
   either padding or the unfused path.
-- **Fused attention at inference, gated per lowering platform.**
+- **Fused attention everywhere, gated per lowering platform.**
   ``train=False`` lowers attention through ops.attention.flash_attention
   (online softmax, no (S,S) matrix in HBM) in the TPU lowering, and through
   the einsum reference in CPU lowerings of the same traced module
   (jax.lax.platform_dependent -- the exporter emits one module for both).
-  The training path always uses the einsum reference: the Pallas kernel
-  defines no VJP, and at these sequence lengths the materialized score
-  matrix is cheap -- XLA fuses mask/softmax into the matmuls.
+  ``train=True`` routes through ops.attention.attention_trainable: the same
+  flash forward plus a custom-VJP blockwise-recompute backward, so training
+  activations stay O(S * block) too.
 - Params stay float32; compute dtype is a module arg (bf16 for serving),
   with LayerNorm always computed in f32 for stability.
 """
@@ -77,7 +77,13 @@ class SelfAttention(nn.Module):
         v = proj("value")(x).transpose(0, 2, 1, 3)
 
         block = attention.pick_block(s)
-        if train or block is None or not attention._HAVE_PALLAS:
+        if train:
+            # Differentiable memory-efficient path: flash forward (on TPU)
+            # with the blockwise-recompute backward -- O(S * block)
+            # activations, so long sequences fine-tune without the (S, S)
+            # score matrix ever landing in HBM.
+            o = attention.attention_trainable(q, k, v)
+        elif block is None or not attention._HAVE_PALLAS:
             o = attention.mha_reference(q, k, v)
         else:
             # Resolve the kernel choice at LOWERING time, not trace time: the

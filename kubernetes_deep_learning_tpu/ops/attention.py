@@ -304,3 +304,112 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)
+
+
+# --- trainable memory-efficient attention ----------------------------------
+# The Pallas kernel defines no VJP, so training previously fell back to the
+# full einsum reference, materializing the (S, S) score matrix in HBM --
+# exactly what flash attention exists to avoid, and the memory wall for
+# long-context fine-tuning.  attention_trainable closes the gap with a
+# custom_vjp: the primal is the fused kernel (per lowering platform, like
+# models.vit), and the backward is the standard FlashAttention recomputation
+# -- a lax.scan over KV blocks that rebuilds each score block from q, k and
+# the saved logsumexp, so backward memory is O(S * block) instead of O(S^2).
+
+
+def _finalize_with_lse(partials, dtype):
+    """(acc, m, l) -> (normalized out, lse = m + log l), shared epilogue."""
+    _, m, l = partials
+    out = finalize_partials(partials).astype(dtype)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return out, m + jnp.log(safe_l)
+
+
+def _forward_with_lse(q, k, v, causal: bool):
+    """(out, lse) with lse the softmax log-normalizer per row."""
+    # Cross-attention (sq != sk) tiles each side independently.
+    block_q = pick_block(q.shape[2])
+    block_k = pick_block(k.shape[2])
+
+    def via_flash(q, k, v):
+        partials = flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=False, return_partials=True,
+        )
+        return _finalize_with_lse(partials, q.dtype)
+
+    def via_reference(q, k, v):
+        return _finalize_with_lse(attend_block(q, k, v, causal=causal), q.dtype)
+
+    if block_q is None or block_k is None or not _HAVE_PALLAS:
+        return via_reference(q, k, v)
+    return jax.lax.platform_dependent(
+        q, k, v, tpu=via_flash, default=via_reference
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def attention_trainable(q, k, v, causal: bool = False):
+    """Differentiable attention, (B, H, S, D), O(S * block) activation memory.
+
+    Forward runs the fused flash kernel in TPU lowerings (einsum reference
+    elsewhere); backward recomputes score blocks from (q, k, lse) in a scan
+    over KV blocks.  The building block for long-context *training* --
+    inference-only callers can keep using flash_attention directly.
+    """
+    out, _ = _forward_with_lse(q, k, v, causal)
+    return out
+
+
+def _attn_fwd(q, k, v, causal: bool):
+    out, lse = _forward_with_lse(q, k, v, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _attn_bwd(causal: bool, res, dout):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block = pick_block(sk) or sk
+    nk = sk // block
+
+    do32 = dout.astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    # D_i = sum_d dO_i * O_i, the softmax-backward row correction.
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # (B,H,Sq)
+
+    # No KV-block skipping under the causal mask: without q-tiling every KV
+    # block is visible to SOME later query row, so there are no fully-masked
+    # blocks to skip (unlike the forward kernel, which bounds its stream per
+    # 128-row q tile).  A 2D-tiled backward would reclaim the triangular
+    # FLOPs for causal training; noted as headroom, not needed by the
+    # (non-causal) ViT path.
+    def body(dq_acc, j):
+        k_j = jax.lax.dynamic_slice_in_dim(k, j * block, block, axis=2)
+        v_j = jax.lax.dynamic_slice_in_dim(v, j * block, block, axis=2)
+        k32 = k_j.astype(jnp.float32)
+        v32 = v_j.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+        if causal:
+            mask = _causal_mask(0, j * block, sq, block)
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])          # (B,H,Sq,block), recomputed
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        body, jnp.zeros(q.shape, jnp.float32), jnp.arange(nk)
+    )
+    # scan stacks per-block grads as (nk, B, H, block, D); reorder the block
+    # axis next to its intra-block dim before flattening to (B, H, Sk, D).
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, sk, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+attention_trainable.defvjp(_attn_fwd, _attn_bwd)

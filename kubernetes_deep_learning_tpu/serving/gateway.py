@@ -41,6 +41,9 @@ SERVING_HOST_ENV = "KDLT_SERVING_HOST"
 MODEL_ENV = "KDLT_MODEL"
 DEFAULT_MODEL = "clothing-model"
 PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
+PER_IMAGE_TIMEOUT_S = 0.25   # extra upstream budget per batched image: a
+                             # 256-image predict is one POST and must not be
+                             # held to the single-image 20 s deadline
 UPSTREAM_RETRY_BACKOFF_S = 0.05  # one retry on the model tier's 503 overload
 MAX_BATCH_FETCHERS = 8       # concurrent image downloads per batch request
 MAX_URLS_PER_REQUEST = 256   # hard cap: bounds per-request image memory
@@ -153,6 +156,9 @@ class Gateway:
         import requests
 
         body = protocol.encode_predict_request(images)
+        timeout = PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(
+            0, images.shape[0] - 1
+        )
         r = None
         for attempt in (0, 1):
             if attempt:
@@ -162,7 +168,7 @@ class Gateway:
                     f"{self._base}/v1/models/{self.model}:predict",
                     data=body,
                     headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
-                    timeout=PREDICT_TIMEOUT_S,
+                    timeout=timeout,
                 )
             except requests.RequestException as e:
                 raise UpstreamError(f"model server unreachable: {e}") from e

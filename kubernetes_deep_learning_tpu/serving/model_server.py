@@ -354,6 +354,10 @@ class ModelServer:
                         + 1_048_576
                     )
                     if length > limit:
+                        # The unread body is still in the socket; a
+                        # keep-alive handler loop would parse it as the next
+                        # request line.  Close instead of draining gigabytes.
+                        self.close_connection = True
                         raise ValueError(
                             f"request body {length} bytes exceeds the "
                             f"{limit}-byte limit "
