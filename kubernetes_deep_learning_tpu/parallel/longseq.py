@@ -51,7 +51,9 @@ def _layer_norm(x, scale, bias):
     return (x32 - mu) * jax.lax.rsqrt(var + _LN_EPS) * scale + bias
 
 
-def _block_shard(x, params, *, cfg: ViTConfig, axis_name: str, n: int, dtype):
+def _block_shard(
+    x, params, *, cfg: ViTConfig, axis_name: str, n: int, dtype, use_flash
+):
     """One transformer block on a (B, S_local, C) token shard.
 
     Everything except attention is tokenwise; attention is the ring
@@ -66,7 +68,9 @@ def _block_shard(x, params, *, cfg: ViTConfig, axis_name: str, n: int, dtype):
         + params["attn"][name]["bias"].astype(dtype)[:, None, :]  # (H,1,D)
     )
     q, k, v = proj("query"), proj("key"), proj("value")
-    o = _ring_shard(q, k, v, axis_name=axis_name, n=n, causal=False, use_flash=None)
+    o = _ring_shard(
+        q, k, v, axis_name=axis_name, n=n, causal=False, use_flash=use_flash
+    )
     o = jnp.einsum(
         "bhsd,hdc->bsc", o.astype(dtype), params["attn"]["out"]["kernel"].astype(dtype)
     ) + params["attn"]["out"]["bias"].astype(dtype)
@@ -80,11 +84,14 @@ def _block_shard(x, params, *, cfg: ViTConfig, axis_name: str, n: int, dtype):
     return x + y
 
 
-def _stack_shard(x, params, *, cfg: ViTConfig, axis_name: str, n: int, dtype, seq: int):
+def _stack_shard(
+    x, params, *, cfg: ViTConfig, axis_name: str, n: int, dtype, seq: int, use_flash
+):
     """All blocks + final LN + the LOCAL half of the mean pool."""
     for i in range(cfg.depth):
         x = _block_shard(
-            x, params[f"block_{i}"], cfg=cfg, axis_name=axis_name, n=n, dtype=dtype
+            x, params[f"block_{i}"], cfg=cfg, axis_name=axis_name, n=n,
+            dtype=dtype, use_flash=use_flash,
         )
     x = _layer_norm(x, params["ln_final"]["scale"], params["ln_final"]["bias"])
     pooled = x.sum(axis=1) / seq            # local partial of the token mean
@@ -97,10 +104,17 @@ def build_sequence_parallel_forward(
     mesh: Mesh,
     dtype=jnp.bfloat16,
     axis_name: str = DATA_AXIS,
+    differentiable: bool = False,
 ):
     """Jitted ``f(variables, uint8_images) -> f32 logits`` with the token
     sequence sharded over ``axis_name``.  ViT families only; the patch-grid
-    token count must divide the axis size."""
+    token count must divide the axis size.
+
+    ``differentiable=True`` forces the ring's einsum attend (the Pallas
+    kernel has no VJP), making the whole forward grad-able through
+    shard_map/ppermute -- context-parallel FINE-TUNING: per-device
+    activations stay O(S/n), gradients ride the same ring.  Serving keeps
+    the default (flash attend where it tiles)."""
     cfg = VIT_CONFIGS.get(spec.family)
     if cfg is None:
         raise ValueError(
@@ -123,7 +137,8 @@ def build_sequence_parallel_forward(
     token_sharding = NamedSharding(mesh, P(None, axis_name, None))
     stack = shard_map(
         functools.partial(
-            _stack_shard, cfg=cfg, axis_name=axis_name, n=n, dtype=dtype, seq=seq
+            _stack_shard, cfg=cfg, axis_name=axis_name, n=n, dtype=dtype,
+            seq=seq, use_flash=False if differentiable else None,
         ),
         mesh=mesh,
         in_specs=(P(None, axis_name, None), P()),
@@ -155,3 +170,46 @@ def build_sequence_parallel_forward(
         return logits.astype(jnp.float32)
 
     return jax.jit(forward)
+
+
+def build_sequence_parallel_train_step(
+    spec: ModelSpec,
+    tx,
+    mesh: Mesh,
+    dtype=jnp.bfloat16,
+    axis_name: str = DATA_AXIS,
+):
+    """Context-parallel fine-tuning step: gradients through the ring.
+
+    Same contract as training.trainer.build_train_step -- jitted
+    ``step(state, uint8_images, labels) -> (state, metrics)`` on a
+    trainer.TrainState -- but the TOKEN axis (not the batch) is sharded over
+    the mesh, so sequences too long for one chip's activations fine-tune on
+    a mesh of n.  ViT families only (BN-free, so batch_stats stays empty).
+    """
+    import optax
+
+    from kubernetes_deep_learning_tpu.training.trainer import TrainState
+
+    fwd = build_sequence_parallel_forward(
+        spec, mesh, dtype=dtype, axis_name=axis_name, differentiable=True
+    )
+
+    def loss_fn(params, images, labels):
+        logits = fwd({"params": params}, images)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+        acc = (logits.argmax(-1) == labels).mean()
+        return loss, acc
+
+    def train_step(state: TrainState, images, labels):
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, images, labels
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            state.step + 1, new_params, state.batch_stats, new_opt_state
+        )
+        return new_state, {"loss": loss, "accuracy": acc}
+
+    return jax.jit(train_step, donate_argnums=(0,))

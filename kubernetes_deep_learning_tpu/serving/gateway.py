@@ -156,8 +156,11 @@ class Gateway:
         import requests
 
         body = protocol.encode_predict_request(images)
-        timeout = PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(
-            0, images.shape[0] - 1
+        # (connect, read) pair: only the READ budget scales with batch size;
+        # an unreachable model tier should still fail fast at connect.
+        timeout = (
+            PREDICT_TIMEOUT_S,
+            PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(0, images.shape[0] - 1),
         )
         r = None
         for attempt in (0, 1):

@@ -33,8 +33,10 @@ def test_forward_shape_and_dtype(tiny_vit_spec):
 
 def test_flash_and_reference_attention_agree(tiny_vit_spec):
     # train=False routes attention through jax.lax.platform_dependent (the
-    # Pallas flash kernel on TPU, einsum on CPU); train=True always uses the
-    # einsum reference.  No dropout/batchnorm, so the paths must agree.
+    # Pallas flash kernel on TPU, einsum on CPU); train=True routes through
+    # attention_trainable (flash forward + custom-VJP blockwise backward,
+    # einsum primal on CPU).  No dropout/batchnorm, so both paths compute
+    # the same function and must agree.
     model = create_model(tiny_vit_spec)
     variables = init_variables(tiny_vit_spec, seed=0)
     rng = np.random.default_rng(0)
