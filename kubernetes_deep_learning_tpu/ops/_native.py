@@ -92,7 +92,7 @@ try:
         ("kdlt_bq_create", [ctypes.c_int, ctypes.c_int64, ctypes.c_int], ctypes.c_void_p),
         ("kdlt_bq_destroy", [ctypes.c_void_p], None),
         ("kdlt_bq_submit", [ctypes.c_void_p, _u8p], ctypes.c_int64),
-        ("kdlt_bq_take", [ctypes.c_void_p, _u8p, ctypes.c_int, ctypes.c_double, _i64p], ctypes.c_int),
+        ("kdlt_bq_take", [ctypes.c_void_p, _u8p, ctypes.c_int, ctypes.c_double, ctypes.c_double, _i64p], ctypes.c_int),
         ("kdlt_bq_complete", [ctypes.c_void_p, _i64p, ctypes.c_int, _f32p, ctypes.c_int], None),
         ("kdlt_bq_fail", [ctypes.c_void_p, _i64p, ctypes.c_int], None),
         ("kdlt_bq_wait", [ctypes.c_void_p, ctypes.c_int64, _f32p, ctypes.c_double], ctypes.c_int),

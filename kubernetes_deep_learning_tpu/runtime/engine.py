@@ -197,20 +197,26 @@ class InferenceEngine:
                 return b
         raise ValueError(f"batch {n} exceeds max bucket {self.max_batch}")
 
-    def predict(self, images: np.ndarray) -> np.ndarray:
-        """uint8 (N,H,W,C) -> float32 logits (N,num_classes); pads to bucket."""
+    def predict_async(self, images: np.ndarray):
+        """Dispatch a uint8 batch WITHOUT the host sync; returns (device_logits, n).
+
+        The caller materializes with ``np.asarray(device_logits)[:n]`` when
+        it needs the values -- letting it stage and dispatch the NEXT batch
+        while this one executes (the batcher's pipelining hook).
+
+        Aliasing contract: ``images`` must stay unmodified until the result
+        is materialized.  Whether jax copies host arrays at dispatch is
+        BACKEND-DEPENDENT (the CPU client can alias aligned host memory
+        zero-copy), so a caller with a reusable staging buffer must
+        double-buffer or copy -- see NativeBatcher's ping-pong buffers.
+        """
         images = np.asarray(images)
         if images.ndim != 4 or images.shape[1:] != self.spec.input_shape:
             raise ValueError(
                 f"expected (N, {self.spec.input_shape}), got {images.shape}"
             )
-        if images.dtype not in (np.uint8, np.float32):
-            raise ValueError(
-                f"dtype {images.dtype} unsupported: send uint8 pixels or "
-                "float32 pre-normalized data"
-            )
-        hot = images.dtype == np.uint8
-        fn = self._jitted if hot else self._f32_forward()
+        if images.dtype != np.uint8:
+            raise ValueError(f"predict_async takes uint8 images, got {images.dtype}")
         n = images.shape[0]
         bucket = self.bucket_for(n)
         if bucket != n:
@@ -218,14 +224,52 @@ class InferenceEngine:
             batch = np.concatenate([images, pad], axis=0)
         else:
             batch = images
-        t0 = time.perf_counter()
-        with self._lock if hot else self._f32_lock:
-            logits = fn(self._variables, batch)
+        with self._lock:
+            logits = self._jitted(self._variables, batch)
+        self._m_images.inc(n)
+        self._m_batches.inc()
+        self._m_pad_waste.inc(bucket - n)
+        return logits, n
+
+    def record_infer_latency(self, seconds: float) -> None:
+        """Feed the device-latency histogram from a pipelined caller.
+
+        predict() measures dispatch->sync itself; async callers sync later
+        (NativeBatcher._finish) and report the interval here so
+        kdlt_engine_infer_seconds keeps emitting on the primary path.
+        """
+        self._m_infer_latency.observe(seconds)
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """uint8 (N,H,W,C) -> float32 logits (N,num_classes); pads to bucket."""
+        images = np.asarray(images)
+        if images.dtype == np.uint8:
+            t0 = time.perf_counter()
+            logits, n = self.predict_async(images)
             out = np.asarray(logits)  # device sync
-        if hot:
-            # The debug path's lazy first compile would otherwise land a
-            # tens-of-seconds sample in the serving latency histogram.
             self._m_infer_latency.observe(time.perf_counter() - t0)
+            return out[:n]
+        if images.dtype != np.float32:
+            raise ValueError(
+                f"dtype {images.dtype} unsupported: send uint8 pixels or "
+                "float32 pre-normalized data"
+            )
+        if images.ndim != 4 or images.shape[1:] != self.spec.input_shape:
+            raise ValueError(
+                f"expected (N, {self.spec.input_shape}), got {images.shape}"
+            )
+        fn = self._f32_forward()
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            pad = np.zeros((bucket - n, *self.spec.input_shape), images.dtype)
+            batch = np.concatenate([images, pad], axis=0)
+        else:
+            batch = images
+        # No latency sample here: the debug path's lazy first compile would
+        # land a tens-of-seconds outlier in the serving histogram.
+        with self._f32_lock:
+            out = np.asarray(fn(self._variables, batch))
         self._m_images.inc(n)
         self._m_batches.inc()
         self._m_pad_waste.inc(bucket - n)

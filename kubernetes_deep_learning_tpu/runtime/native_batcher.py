@@ -78,7 +78,13 @@ class NativeBatcher:
             "kdlt_batcher_rejected_total", "requests rejected because queue was full"
         )
         # Dispatcher-owned staging buffers; only this thread touches them.
-        self._batch_buf = np.empty((self.max_batch, *self._item_shape), np.uint8)
+        # TWO batch buffers, used ping-pong: predict_async's aliasing
+        # contract forbids touching a dispatched batch until its sync, and
+        # with a depth-2 pipeline exactly one batch is in flight while the
+        # next is being assembled.
+        self._batch_bufs = [
+            np.empty((self.max_batch, *self._item_shape), np.uint8) for _ in range(2)
+        ]
         self._tickets = np.empty(self.max_batch, np.int64)
         self._thread = threading.Thread(
             target=self._run, name="kdlt-native-batcher", daemon=True
@@ -90,36 +96,87 @@ class NativeBatcher:
     def _run(self) -> None:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i64p = ctypes.POINTER(ctypes.c_int64)
-        f32p = ctypes.POINTER(ctypes.c_float)
-        buf = self._batch_buf.ctypes.data_as(u8p)
         tix = self._tickets.ctypes.data_as(i64p)
+        # Depth-2 pipeline: while the device executes batch N (staged in one
+        # buffer), this thread takes, assembles (into the OTHER buffer), and
+        # DISPATCHES batch N+1, then syncs N.  The device never idles
+        # between batches on dispatch/assembly time (on tunnel-attached dev
+        # chips that hides an entire round trip).
+        use_async = hasattr(self._engine, "predict_async")
+        pending = None  # (tickets_copy, n, device_logits, dispatched_at)
+        slot = 0
         while True:
-            # Blocks in C (GIL released) until work or close+drain.
+            # Waits in C (GIL released).  With a batch in flight the wait is
+            # BOUNDED: on an idle queue the dispatcher must come back to sync
+            # the in-flight batch rather than strand its waiters; take
+            # returns -1 when the bounded wait expires with no work.
+            wait_s = self.max_delay if pending is not None else -1.0
+            staging = self._batch_bufs[slot]
             n = self._lib.kdlt_bq_take(
-                self._q, buf, self.max_batch, self.max_delay, tix
+                self._q, staging.ctypes.data_as(u8p), self.max_batch,
+                self.max_delay, wait_s, tix,
             )
+            if n == -1:  # no new work while a batch is in flight: sync it
+                self._finish(*pending)
+                pending = None
+                continue
             if n == 0:
+                if pending is not None:
+                    self._finish(*pending)
                 return
             self._m_batch_size.observe(n)
+            tickets = self._tickets[:n].copy()
+            current = None
             try:
-                logits = np.ascontiguousarray(
-                    self._engine.predict(self._batch_buf[:n]), dtype=np.float32
-                )
-                self._lib.kdlt_bq_complete(
-                    self._q, tix, n, logits.ctypes.data_as(f32p), self._out_floats
-                )
-            except Exception as e:  # propagate to all waiters, keep serving
-                now = time.monotonic()
-                with self._errors_lock:
-                    expired = [
-                        t for t, (_, ts) in self._errors.items()
-                        if now - ts > self._error_ttl_s
-                    ]
-                    for t in expired:
-                        del self._errors[t]
-                    for t in self._tickets[:n]:
-                        self._errors[int(t)] = (e, now)
-                self._lib.kdlt_bq_fail(self._q, tix, n)
+                if use_async:
+                    device_logits, _ = self._engine.predict_async(staging[:n])
+                    current = (tickets, n, device_logits, time.perf_counter())
+                    slot ^= 1  # the dispatched buffer is now off-limits
+                else:  # plain engines (tests, wrappers): dispatch+sync now
+                    self._finish(
+                        tickets, n, self._engine.predict(staging[:n]), None
+                    )
+            except Exception as e:
+                self._fail(tickets, n, e)
+            if pending is not None:
+                self._finish(*pending)
+            pending = current
+
+    def _finish(self, tickets: np.ndarray, n: int, logits, dispatched_at) -> None:
+        """Sync a dispatched batch and publish its rows (or its failure)."""
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        try:
+            rows = np.ascontiguousarray(np.asarray(logits)[:n], dtype=np.float32)
+        except Exception as e:  # device-side failure surfaces at sync
+            self._fail(tickets, n, e)
+            return
+        if dispatched_at is not None and hasattr(self._engine, "record_infer_latency"):
+            # Async dispatch skips the engine's own dispatch->sync timing;
+            # report it here so the device-latency histogram stays live.
+            self._engine.record_infer_latency(time.perf_counter() - dispatched_at)
+        self._lib.kdlt_bq_complete(
+            self._q,
+            tickets.ctypes.data_as(i64p),
+            n,
+            rows.ctypes.data_as(f32p),
+            self._out_floats,
+        )
+
+    def _fail(self, tickets: np.ndarray, n: int, e: BaseException) -> None:
+        """Record the error per ticket and wake the batch's waiters."""
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        now = time.monotonic()
+        with self._errors_lock:
+            expired = [
+                t for t, (_, ts) in self._errors.items()
+                if now - ts > self._error_ttl_s
+            ]
+            for t in expired:
+                del self._errors[t]
+            for t in tickets[:n]:
+                self._errors[int(t)] = (e, now)
+        self._lib.kdlt_bq_fail(self._q, tickets.ctypes.data_as(i64p), n)
 
     # --- request side ------------------------------------------------------
 

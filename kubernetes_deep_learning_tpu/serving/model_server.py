@@ -345,10 +345,13 @@ class ModelServer:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     spec = model.artifact.spec
-                    # Enforce the batch bound BEFORE reading/decoding: a cap
+                    # Enforce the byte bound BEFORE reading/decoding: a cap
                     # checked after np-materializing the body would not bound
-                    # memory at all.  uint8 wire bytes ~= pixels; 2x covers
-                    # JSON's decimal encoding overhead per float32 pixel.
+                    # memory at all.  Sized for the production wire (msgpack
+                    # uint8, ~1 byte/pixel) with 8x headroom for debug JSON;
+                    # verbose float JSON (~10-20 chars/pixel) hits this byte
+                    # bound before the image-count cap below -- intended,
+                    # since memory protection is the primary goal here.
                     limit = (
                         MAX_IMAGES_PER_REQUEST * int(np.prod(spec.input_shape)) * 8
                         + 1_048_576

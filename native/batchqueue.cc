@@ -165,23 +165,35 @@ int64_t kdlt_bq_submit(void* handle, const uint8_t* image) {
   return ticket_of(*q, idx, gen);
 }
 
-// Dispatcher side.  Blocks until work (or close); lingers up to
+// Dispatcher side.  Waits for work (forever when wait_s < 0, else up to
+// wait_s -- the bounded mode lets a pipelining dispatcher come back to sync
+// an in-flight batch instead of blocking on an idle queue); lingers up to
 // max_delay_s while the batch is smaller than max_batch; then copies the
 // taken images into dst (contiguous, arrival order) and writes their
-// tickets.  Returns the batch size, or 0 when the queue is closed and
-// drained (the dispatcher should exit).
+// tickets.  Returns the batch size, 0 when the queue is closed and drained
+// (the dispatcher should exit), or -1 when wait_s expired with no work.
 int kdlt_bq_take(void* handle, uint8_t* dst, int max_batch,
-                 double max_delay_s, int64_t* tickets) {
+                 double max_delay_s, double wait_s, int64_t* tickets) {
   auto* q = static_cast<BatchQueue*>(handle);
   std::vector<int> taken;
   std::unique_lock<std::mutex> lk(q->mu);
   ActiveGuard guard(q, lk);
+  auto work_ready = [&] { return q->closed || !q->pending.empty(); };
+  auto wait_deadline =
+      wait_s < 0 ? Clock::time_point::max()
+                 : Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                      std::chrono::duration<double>(wait_s));
   // Outer loop: a round may pop only abandoned slots (every queued waiter
   // timed out while the engine was stuck on the previous batch).  That must
   // NOT return 0 -- 0 is the dispatcher-exit sentinel, and exiting on an
   // open queue would leave the model silently dead -- so go back to waiting.
   while (taken.empty()) {
-    q->cv_work.wait(lk, [&] { return q->closed || !q->pending.empty(); });
+    if (wait_s < 0) {
+      q->cv_work.wait(lk, work_ready);
+    } else if (!q->cv_work.wait_until(lk, wait_deadline, work_ready)) {
+      guard.release(lk);
+      return -1;  // bounded wait expired with no work
+    }
     if (q->pending.empty()) {  // closed and drained
       guard.release(lk);
       return 0;

@@ -24,7 +24,7 @@ void* kdlt_bq_create(int capacity, int64_t item_bytes, int out_floats);
 void kdlt_bq_destroy(void* q);
 int64_t kdlt_bq_submit(void* q, const uint8_t* image);
 int kdlt_bq_take(void* q, uint8_t* dst, int max_batch, double max_delay_s,
-                 int64_t* tickets);
+                 double wait_s, int64_t* tickets);
 void kdlt_bq_complete(void* q, const int64_t* tickets, int n,
                       const float* logits, int row_floats);
 void kdlt_bq_fail(void* q, const int64_t* tickets, int n);
@@ -80,7 +80,7 @@ void dispatcher(void* q) {
   float logits[kMaxBatch * kOutFloats];
   long batches = 0;
   for (;;) {
-    int n = kdlt_bq_take(q, buf.data(), kMaxBatch, 0.0005, tickets);
+    int n = kdlt_bq_take(q, buf.data(), kMaxBatch, 0.0005, -1.0, tickets);
     if (n == 0) return;  // closed and drained
     ++batches;
     if (batches % 97 == 0) {  // injected engine failure

@@ -253,3 +253,56 @@ def test_served_through_model_server(tmp_path):
         assert set(r.json()["predictions"][0]) == {"a", "b"}
     finally:
         server.shutdown()
+
+
+class FakeAsyncEngine(FakeEngine):
+    """Engine exposing the predict_async pipelining hook."""
+
+    def predict_async(self, images):
+        return self.predict(np.array(images)), images.shape[0]
+
+
+class LazyFailure:
+    """predict_async result whose materialization (device sync) fails."""
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError("device exploded at sync")
+
+
+def test_async_pipeline_roundtrip_and_mapping():
+    eng = FakeAsyncEngine(delay_s=0.01)
+    b = NativeBatcher(eng, max_delay_ms=2)
+    results, errors = {}, []
+
+    def worker(v):
+        try:
+            results[v] = b.predict(_img(v))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(v,)) for v in range(30)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for v in range(30):
+            assert results[v].tolist() == [v * 12.0, v * 24.0], v
+        assert max(eng.batch_sizes) > 1  # pipelined batches still coalesce
+    finally:
+        b.close()
+
+
+def test_async_sync_failure_fails_only_its_batch():
+    eng = FakeAsyncEngine()
+    b = NativeBatcher(eng, max_delay_ms=1)
+    try:
+        real = eng.predict_async
+        eng.predict_async = lambda images: (LazyFailure(), images.shape[0])
+        with pytest.raises(RuntimeError, match="device exploded"):
+            b.predict(_img(1))
+        eng.predict_async = real
+        assert b.predict(_img(2)).tolist() == [24.0, 48.0]
+    finally:
+        b.close()
