@@ -147,7 +147,9 @@ class InferenceEngine:
         registry = registry or metrics_lib.Registry()
         self.registry = registry
         self._m_infer_latency = registry.histogram(
-            "kdlt_engine_infer_seconds", "device execute latency per dispatch"
+            "kdlt_engine_infer_seconds",
+            "batch latency dispatch->sync (pipelined serving may include "
+            "bounded queue-wait/assembly overlap)",
         )
         self._m_images = registry.counter("kdlt_engine_images_total", "images executed")
         self._m_batches = registry.counter("kdlt_engine_batches_total", "batches executed")
@@ -226,19 +228,22 @@ class InferenceEngine:
             batch = images
         with self._lock:
             logits = self._jitted(self._variables, batch)
-        self._m_images.inc(n)
-        self._m_batches.inc()
-        self._m_pad_waste.inc(bucket - n)
         return logits, n
 
-    def record_infer_latency(self, seconds: float) -> None:
-        """Feed the device-latency histogram from a pipelined caller.
+    def record_completed(self, n: int, seconds: float) -> None:
+        """Account a successfully SYNCED async batch (counters + latency).
 
-        predict() measures dispatch->sync itself; async callers sync later
-        (NativeBatcher._finish) and report the interval here so
-        kdlt_engine_infer_seconds keeps emitting on the primary path.
+        predict() accounts its own sync path; async callers (NativeBatcher.
+        _finish) report here after materialization succeeds, so failed
+        batches never inflate the success counters, and
+        kdlt_engine_infer_seconds keeps emitting on the pipelined path.
+        The reported interval is dispatch->sync, which under pipelining can
+        include bounded queue-wait/assembly overlap (see the histogram help).
         """
         self._m_infer_latency.observe(seconds)
+        self._m_images.inc(n)
+        self._m_batches.inc()
+        self._m_pad_waste.inc(self.bucket_for(n) - n)
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """uint8 (N,H,W,C) -> float32 logits (N,num_classes); pads to bucket."""
@@ -247,7 +252,7 @@ class InferenceEngine:
             t0 = time.perf_counter()
             logits, n = self.predict_async(images)
             out = np.asarray(logits)  # device sync
-            self._m_infer_latency.observe(time.perf_counter() - t0)
+            self.record_completed(n, time.perf_counter() - t0)
             return out[:n]
         if images.dtype != np.float32:
             raise ValueError(

@@ -143,25 +143,35 @@ class NativeBatcher:
             pending = current
 
     def _finish(self, tickets: np.ndarray, n: int, logits, dispatched_at) -> None:
-        """Sync a dispatched batch and publish its rows (or its failure)."""
+        """Sync a dispatched batch and publish its rows (or its failure).
+
+        MUST NOT raise: an exception escaping here kills the dispatcher
+        thread on an open queue -- the silently-dead-model state the C++
+        take() contract exists to prevent.  Anything unexpected fails the
+        batch's tickets instead.
+        """
         i64p = ctypes.POINTER(ctypes.c_int64)
         f32p = ctypes.POINTER(ctypes.c_float)
         try:
             rows = np.ascontiguousarray(np.asarray(logits)[:n], dtype=np.float32)
+            if dispatched_at is not None and hasattr(self._engine, "record_completed"):
+                # Async dispatch skips the engine's own sync-side accounting;
+                # report AFTER materialization succeeded so failed batches
+                # never inflate the success counters.
+                self._engine.record_completed(n, time.perf_counter() - dispatched_at)
         except Exception as e:  # device-side failure surfaces at sync
             self._fail(tickets, n, e)
             return
-        if dispatched_at is not None and hasattr(self._engine, "record_infer_latency"):
-            # Async dispatch skips the engine's own dispatch->sync timing;
-            # report it here so the device-latency histogram stays live.
-            self._engine.record_infer_latency(time.perf_counter() - dispatched_at)
-        self._lib.kdlt_bq_complete(
-            self._q,
-            tickets.ctypes.data_as(i64p),
-            n,
-            rows.ctypes.data_as(f32p),
-            self._out_floats,
-        )
+        try:
+            self._lib.kdlt_bq_complete(
+                self._q,
+                tickets.ctypes.data_as(i64p),
+                n,
+                rows.ctypes.data_as(f32p),
+                self._out_floats,
+            )
+        except Exception as e:  # pragma: no cover - ctypes-layer failure
+            self._fail(tickets, n, e)
 
     def _fail(self, tickets: np.ndarray, n: int, e: BaseException) -> None:
         """Record the error per ticket and wake the batch's waiters."""

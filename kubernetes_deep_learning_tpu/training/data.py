@@ -121,3 +121,70 @@ def map_batches(
     """Lazy per-batch transform (augmentation hook) on the host side."""
     for batch in source:
         yield fn(batch)
+
+
+def image_folder_batches(
+    root: str,
+    spec: ModelSpec,
+    batch: int,
+    epochs: int | None = None,
+    seed: int = 0,
+    drop_remainder: bool = True,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """(images, labels) batches from a ``<root>/<label>/<file>`` directory tree.
+
+    The classic layout (one subdirectory per class, the bookcamp clothing
+    dataset's own structure).  Labels map through ``spec.labels`` -- a
+    subdirectory not in the spec is a loud error, not silent skipping.
+    Decode + resize happen here on the host (the C++ batch-resize kernel
+    when available); normalization stays on device as everywhere else.
+    Shuffles each epoch; ``epochs=None`` repeats forever.
+    """
+    import os
+
+    from kubernetes_deep_learning_tpu.ops.preprocess import preprocess_bytes
+
+    image_exts = {".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp"}
+    label_to_index = {label: i for i, label in enumerate(spec.labels)}
+    samples: list[tuple[str, int]] = []
+    for entry in sorted(os.listdir(root)):
+        class_dir = os.path.join(root, entry)
+        if not os.path.isdir(class_dir):
+            continue
+        if entry not in label_to_index:
+            raise ValueError(
+                f"directory {entry!r} is not a spec label; expected one of "
+                f"{list(spec.labels)}"
+            )
+        for fname in sorted(os.listdir(class_dir)):
+            path = os.path.join(class_dir, fname)
+            # Filter at SCAN time: a stray .DS_Store/README/subdirectory must
+            # not crash the iterator mid-epoch.
+            if os.path.splitext(fname)[1].lower() in image_exts and os.path.isfile(path):
+                samples.append((path, label_to_index[entry]))
+    if not samples:
+        raise FileNotFoundError(f"no class directories with images under {root!r}")
+
+    rng = np.random.default_rng(seed)
+    size = spec.input_shape[:2]
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(len(samples))
+        for start in range(0, len(order), batch):
+            idx = order[start : start + batch]
+            if drop_remainder and len(idx) < batch:
+                break
+            images = np.empty((len(idx), *spec.input_shape), np.uint8)
+            labels = np.empty(len(idx), np.int32)
+            for row, i in enumerate(idx):
+                path, label = samples[i]
+                with open(path, "rb") as f:
+                    # The gateway's exact host pipeline (decode + resize with
+                    # the spec's filter), so training and serving can never
+                    # diverge on preprocessing.
+                    images[row] = preprocess_bytes(
+                        f.read(), size, filter=spec.resize_filter
+                    )
+                labels[row] = label
+            yield images, labels
+        epoch += 1
