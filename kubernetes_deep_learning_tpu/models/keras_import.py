@@ -9,7 +9,9 @@ TensorFlow in the loop -- so the reference's expected logits
 Keras layer names are preserved by the flax modules for named layers
 (block1_conv1, ...); layers Keras auto-names (the four residual 1x1 convs and
 their BatchNorms, and the head Dense layers) are matched structurally by
-weight shape, which is unique per site in Xception.
+weight shape, which is unique per site in Xception.  ResNet50 imports are a
+purely syntactic rename (keras.applications names are flat, ours nest the
+identical components).
 """
 
 from __future__ import annotations
@@ -80,6 +82,48 @@ def _dense_layers_in_order(layers: dict[str, dict[str, np.ndarray]]):
     return [(name, w) for _, name, w in sorted(found)]
 
 
+def _head_from_denses(spec: ModelSpec, layers: dict[str, dict[str, np.ndarray]]):
+    """Build the ClassifierHead params from the .h5's Dense layers.
+
+    Auto-named chains (dense, dense_1, ...) map in creation order, last one
+    = logits; otherwise a single Dense under any name (Keras calls the
+    ImageNet head "predictions") is the logits layer.  Validates hidden
+    sizes and class count against the spec so mismatched artifacts fail
+    with a clear message, not a structure diff.
+    """
+    denses = _dense_layers_in_order(layers)
+    if not denses:
+        others = [
+            (n, w) for n, w in layers.items()
+            if "kernel" in w and w["kernel"].ndim == 2
+        ]
+        if len(others) != 1:
+            raise ValueError(
+                "no Dense head layers found in .h5"
+                if not others
+                else f"ambiguous head Dense layers: {[n for n, _ in others]}"
+            )
+        denses = others
+    head: dict = {}
+    *hidden, (_, logits_w) = denses
+    for i, (_, w) in enumerate(hidden):
+        head[f"hidden_{i}"] = {"kernel": w["kernel"], "bias": w["bias"]}
+    head["logits"] = {"kernel": logits_w["kernel"], "bias": logits_w["bias"]}
+
+    hidden_sizes = tuple(w["kernel"].shape[1] for _, w in hidden)
+    if hidden_sizes != spec.head_hidden:
+        raise ValueError(
+            f".h5 head hidden sizes {hidden_sizes} do not match spec "
+            f"{spec.head_hidden}; fix the ModelSpec to match the artifact"
+        )
+    if logits_w["kernel"].shape[1] != spec.num_classes:
+        raise ValueError(
+            f".h5 logits width {logits_w['kernel'].shape[1]} != "
+            f"{spec.num_classes} labels"
+        )
+    return head
+
+
 def xception_variables_from_keras(
     spec: ModelSpec, layers: dict[str, dict[str, np.ndarray]]
 ):
@@ -112,26 +156,53 @@ def xception_variables_from_keras(
                 put_bn(target, w)
 
     # Head: auto-named Dense layers in creation order; last one is logits.
-    denses = _dense_layers_in_order(layers)
-    if not denses:
-        raise ValueError("no Dense layers found in .h5 (expected classifier head)")
-    head: dict = {}
-    *hidden, (_, logits_w) = denses
-    for i, (_, w) in enumerate(hidden):
-        head[f"hidden_{i}"] = {"kernel": w["kernel"], "bias": w["bias"]}
-    head["logits"] = {"kernel": logits_w["kernel"], "bias": logits_w["bias"]}
-    params["head"] = head
+    params["head"] = _head_from_denses(spec, layers)
 
-    hidden_sizes = tuple(w["kernel"].shape[1] for _, w in hidden)
-    if hidden_sizes != spec.head_hidden:
-        raise ValueError(
-            f".h5 head hidden sizes {hidden_sizes} do not match spec "
-            f"{spec.head_hidden}; fix the ModelSpec to match the artifact"
-        )
-    if logits_w["kernel"].shape[1] != spec.num_classes:
-        raise ValueError(
-            f".h5 logits width {logits_w['kernel'].shape[1]} != {spec.num_classes} labels"
-        )
+    variables = {"params": params, "batch_stats": stats}
+    _check_structure(spec, variables)
+    return variables
+
+
+_RESNET_CONV_RE = re.compile(r"(conv\d_block\d+)_(\d)_conv")
+_RESNET_BN_RE = re.compile(r"(conv\d_block\d+)_(\d)_bn")
+
+
+def resnet50_variables_from_keras(
+    spec: ModelSpec, layers: dict[str, dict[str, np.ndarray]]
+):
+    """Build flax variables for models.resnet.ResNet50 from Keras weights.
+
+    keras.applications.ResNet50 names are flat (``conv2_block1_1_conv``);
+    our module nests the same names (``conv2_block1/1_conv``), so the map is
+    purely syntactic -- no shape-based matching needed.
+    """
+    params: dict = {}
+    stats: dict = {}
+
+    def put_bn(block: str | None, name: str, layer):
+        p, s = _bn(layer)
+        if block is None:
+            params[name] = p
+            stats[name] = s
+        else:
+            params.setdefault(block, {})[name] = p
+            stats.setdefault(block, {})[name] = s
+
+    for name, w in layers.items():
+        if name == "conv1_conv":
+            params[name] = {"kernel": w["kernel"], "bias": w["bias"]}
+        elif name == "conv1_bn":
+            put_bn(None, name, w)
+        elif m := _RESNET_CONV_RE.fullmatch(name):
+            params.setdefault(m.group(1), {})[f"{m.group(2)}_conv"] = {
+                "kernel": w["kernel"], "bias": w["bias"]
+            }
+        elif m := _RESNET_BN_RE.fullmatch(name):
+            put_bn(m.group(1), f"{m.group(2)}_bn", w)
+
+    # Head: "predictions" (stock ImageNet) or a dense/dense_1/... fine-tuned
+    # chain -- same handling as xception, including head_hidden support.
+    params["head"] = _head_from_denses(spec, layers)
 
     variables = {"params": params, "batch_stats": stats}
     _check_structure(spec, variables)
@@ -168,4 +239,6 @@ def load_keras_h5(spec: ModelSpec, path: str):
     layers = read_keras_h5(path)
     if spec.family == "xception":
         return xception_variables_from_keras(spec, layers)
+    if spec.family == "resnet50":
+        return resnet50_variables_from_keras(spec, layers)
     raise NotImplementedError(f"Keras import not implemented for {spec.family!r}")

@@ -13,6 +13,7 @@ import pytest
 
 from kubernetes_deep_learning_tpu.models import build_forward, init_variables
 from kubernetes_deep_learning_tpu.models.keras_import import load_keras_h5
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
 
 
 def _flax_to_keras_h5(path, variables):
@@ -119,3 +120,105 @@ def test_h5_import_rejects_wrong_head(tmp_path, h5_spec):
     bad_spec = dataclasses.replace(h5_spec, head_hidden=(32,))
     with pytest.raises(ValueError, match="head hidden"):
         load_keras_h5(bad_spec, str(path))
+
+
+def _flax_resnet_to_keras_h5(path, variables):
+    """Write flax ResNet50 variables as a keras.applications-style .h5."""
+    import h5py
+
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def conv_entry(p):
+        return {"kernel": p["kernel"], "bias": p["bias"]}
+
+    def bn_entry(p, s):
+        return {
+            "gamma": p["scale"], "beta": p["bias"],
+            "moving_mean": s["mean"], "moving_variance": s["var"],
+        }
+
+    entries = {
+        "conv1_conv": conv_entry(params["conv1_conv"]),
+        "conv1_bn": bn_entry(params["conv1_bn"], stats["conv1_bn"]),
+        "predictions": {
+            "kernel": params["head"]["logits"]["kernel"],
+            "bias": params["head"]["logits"]["bias"],
+        },
+    }
+    for block, sub in params.items():
+        if "_block" not in block:
+            continue
+        for k in ("0", "1", "2", "3"):
+            if f"{k}_conv" in sub:
+                entries[f"{block}_{k}_conv"] = conv_entry(sub[f"{k}_conv"])
+            if f"{k}_bn" in sub:
+                entries[f"{block}_{k}_bn"] = bn_entry(
+                    sub[f"{k}_bn"], stats[block][f"{k}_bn"]
+                )
+    with h5py.File(path, "w") as f:
+        root = f.create_group("model_weights")
+        for layer, weights in entries.items():
+            g = root.create_group(layer)
+            for wname, arr in weights.items():
+                g.create_dataset(f"{wname}:0", data=np.asarray(arr))
+
+
+def test_resnet50_h5_roundtrip_bitexact(tmp_path):
+    import jax
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.models.keras_import import load_keras_h5
+
+    spec = register_spec(
+        ModelSpec(
+            name="h5-resnet",
+            family="resnet50",
+            input_shape=(64, 64, 3),
+            labels=("a", "b", "c"),
+            preprocessing="caffe",
+        )
+    )
+    variables = init_variables(spec, seed=3)
+    path = tmp_path / "resnet.h5"
+    _flax_resnet_to_keras_h5(str(path), variables)
+    imported = load_keras_h5(spec, str(path))
+
+    flat_a, tree_a = jax.tree_util.tree_flatten(variables)
+    flat_b, tree_b = jax.tree_util.tree_flatten(imported)
+    assert tree_a == tree_b
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    fwd = build_forward(spec, dtype=None)
+    x = np.random.default_rng(0).integers(0, 256, (2, 64, 64, 3), np.uint8)
+    np.testing.assert_allclose(
+        np.asarray(fwd(variables, x)), np.asarray(fwd(imported, x)), atol=0
+    )
+
+
+def test_resnet50_h5_rejects_wrong_head(tmp_path):
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.models.keras_import import load_keras_h5
+
+    spec = register_spec(
+        ModelSpec(
+            name="h5-resnet-wrong",
+            family="resnet50",
+            input_shape=(64, 64, 3),
+            labels=("a", "b", "c"),
+            preprocessing="caffe",
+        )
+    )
+    donor = register_spec(
+        ModelSpec(
+            name="h5-resnet-donor",
+            family="resnet50",
+            input_shape=(64, 64, 3),
+            labels=("a", "b"),  # 2-class head, spec expects 3
+            preprocessing="caffe",
+        )
+    )
+    path = tmp_path / "wrong.h5"
+    _flax_resnet_to_keras_h5(str(path), init_variables(donor, seed=0))
+    with pytest.raises(ValueError, match="logits width"):
+        load_keras_h5(spec, str(path))
