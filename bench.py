@@ -6,17 +6,28 @@ delegates to TF-Serving's C++ binary) on the Xception clothing classifier:
 batch-swept images/sec plus per-batch device latency, against the
 BASELINE.json target of >=4000 images/sec/chip at p50 <= 15 ms.
 
-Measurement method: K forward passes are chained inside ONE jit program via
-lax.scan and the whole call is timed, giving steady-state device throughput.
-Per-call ("dispatch") timing is reported separately -- on this machine the
-TPU sits behind a network tunnel whose ~70 ms round trip would otherwise
-swamp the measurement entirely (and, worse, repeated identical dispatches
-report sub-ms fantasy numbers because readiness is tracked controller-side).
-A production pod talks to its chips over PCIe, where dispatch overhead is
-tens of microseconds; the scan number is the honest chip capability.
+Measurement method -- two independent methods, cross-checked:
+
+1. *Chained scan*: K forward passes run inside ONE jit program via lax.scan,
+   where each iteration's INPUT depends on the previous iteration's logits
+   (a data-dependent low-bit flip of the image).  Round 1 chained only an
+   accumulator, leaving ``fwd(v, x)`` loop-invariant; XLA's while-loop
+   invariant code motion hoisted the forward out of the loop and the bench
+   reported physically impossible numbers (~690% of v5e bf16 peak).  The
+   data dependence makes hoisting illegal.
+2. *Pipelined dispatch*: K independent jit calls dispatched asynchronously,
+   blocked on together.  The device queue runs them back to back, which
+   amortizes this machine's ~70 ms tunnel RTT per dispatch the same way a
+   production pod's PCIe dispatch (tens of us) would.
+
+The headline is the **minimum** of the two methods at the best batch size
+within the p50<=15 ms bound, and the JSON self-flags impossibility: it
+reports MFU = img/s x FLOPs/image / device peak, computed from XLA's own
+cost analysis.  MFU > 100% means the measurement is wrong, by construction.
 
 Prints ONE JSON line to stdout:
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+     "mfu_pct": N}
 Detail goes to stderr.
 """
 
@@ -38,7 +49,42 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_name):
+# Per-chip dense peak (TFLOP/s) for the compute dtype, keyed by substrings of
+# jax's Device.device_kind.  Used only to compute the MFU sanity figure; an
+# unknown device reports mfu as null rather than guessing.
+PEAK_TFLOPS_BY_KIND = {
+    "v5 lite": {"bfloat16": 197.0, "float32": 98.5},   # v5e datasheet
+    "v5e": {"bfloat16": 197.0, "float32": 98.5},
+    "v5p": {"bfloat16": 459.0, "float32": 229.5},
+    "v4": {"bfloat16": 275.0, "float32": 137.5},
+    "v6 lite": {"bfloat16": 918.0, "float32": 459.0},  # Trillium
+    "v6e": {"bfloat16": 918.0, "float32": 459.0},
+}
+
+
+def peak_tflops(device, dtype_name: str) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peaks in PEAK_TFLOPS_BY_KIND.items():
+        if sub in kind:
+            return peaks[dtype_name]
+    return None
+
+
+def compiled_flops_per_image(jitted, batch: int, *example_args) -> float | None:
+    """FLOPs/image of the compiled forward, from XLA's own cost analysis."""
+    try:
+        ca = jitted.lower(*example_args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        return flops / batch if flops > 0 else None
+    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
+        log(f"cost analysis unavailable: {e!r}")
+        return None
+
+
+def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_name,
+                  peak_override=0.0):
     import jax
     import jax.numpy as jnp
 
@@ -56,23 +102,45 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
         variables = cast_params(variables, jnp.bfloat16)
     variables = jax.device_put(variables, dev)
     fwd = build_forward(spec, dtype=dtype)
+    fwd_jit = jax.jit(fwd)
 
     @partial(jax.jit, static_argnums=2)
     def chained(v, x, k):
-        # Sum-consume every output so no forward can be elided; carry makes
-        # the scan body sequential, so wall time / k = per-batch latency.
-        def body(acc, _):
-            return acc + fwd(v, x).sum(), None
+        # Each iteration's input depends on the previous iteration's logits
+        # (flip every pixel's low bit whenever the running logit sum goes
+        # negative), so the forward is NOT loop-invariant and XLA cannot
+        # hoist it out of the scan.  Round 1 chained only an accumulator,
+        # which LICM hoisted, yielding impossible numbers (VERDICT.md).
+        # The perturbation is one elementwise xor -- noise next to the
+        # ~17 GFLOP forward -- and keeps uint8 inputs uint8.
+        def body(carry, _):
+            acc, xi = carry
+            s = fwd(v, xi).sum()
+            bit = jnp.signbit(s).astype(xi.dtype)
+            return (acc + s.astype(jnp.float32), xi ^ bit), None
 
-        acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None, length=k)
+        (acc, _), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), x), None, length=k
+        )
         return acc
 
     rng = np.random.default_rng(0)
+    peak = peak_override * 1e12 if peak_override else None
+    if peak is None:
+        p = peak_tflops(dev, dtype_name)
+        peak = p * 1e12 if p else None
     results = {}
+    flops_img = None
     for b in batch_sizes:
         x = jax.device_put(
             rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
         )
+        if flops_img is None:
+            flops_img = compiled_flops_per_image(fwd_jit, b, variables, x)
+            if flops_img:
+                log(f"compiled forward: {flops_img / 1e9:.2f} GFLOPs/image (XLA cost analysis)")
+
+        # Method 1: data-dependent chained scan.
         t0 = time.perf_counter()
         float(chained(variables, x, scan_len))  # compile + first run
         compile_s = time.perf_counter() - t0
@@ -81,23 +149,51 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
             t0 = time.perf_counter()
             float(chained(variables, x, scan_len))
             per_step.append((time.perf_counter() - t0) / scan_len)
-
         per_step = np.array(per_step)
-        p50 = float(np.percentile(per_step, 50) * 1e3)
-        img_s = b / np.median(per_step)
+        scan_p50_ms = float(np.percentile(per_step, 50) * 1e3)
+        scan_img_s = b / float(np.median(per_step))
+
+        # Method 2: pipelined async dispatch of independent forwards.  Each
+        # call materializes its own output buffer, so the device must run
+        # every one; dispatches overlap execution, amortizing the tunnel RTT.
+        jax.block_until_ready(fwd_jit(variables, x))  # warm this shape
+        pipe_times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            outs = [fwd_jit(variables, x) for _ in range(scan_len)]
+            jax.block_until_ready(outs)
+            pipe_times.append((time.perf_counter() - t0) / scan_len)
+        pipe_p50_ms = float(np.percentile(pipe_times, 50) * 1e3)
+        pipe_img_s = b / float(np.median(pipe_times))
+
+        # Headline candidate: the conservative minimum of the two methods.
+        img_s = min(scan_img_s, pipe_img_s)
+        p50 = max(scan_p50_ms, pipe_p50_ms)
+        agree = min(scan_img_s, pipe_img_s) / max(scan_img_s, pipe_img_s)
+        mfu = (img_s * flops_img / peak) if (peak and flops_img) else None
         results[b] = {
             "img_per_s": float(img_s),
+            "scan_img_per_s": float(scan_img_s),
+            "pipelined_img_per_s": float(pipe_img_s),
+            "method_agreement": float(agree),
             "p50_ms": p50,
-            "best_ms": float(per_step.min() * 1e3),
-            "worst_ms": float(per_step.max() * 1e3),
+            "best_ms": float(min(per_step.min(), min(pipe_times)) * 1e3),
+            "worst_ms": float(max(per_step.max(), max(pipe_times)) * 1e3),
             "compile_s": float(compile_s),
+            "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
         }
+        mfu_s = f"  MFU {results[b]['mfu_pct']:5.1f}%" if mfu is not None else ""
         log(
-            f"batch {b:4d}: {img_s:9.1f} img/s  device p50 {p50:7.2f} ms  "
-            f"best {results[b]['best_ms']:7.2f}  worst {results[b]['worst_ms']:7.2f} ms  "
-            f"(compile {compile_s:.1f}s)"
+            f"batch {b:4d}: {img_s:9.1f} img/s (scan {scan_img_s:.0f} / "
+            f"pipelined {pipe_img_s:.0f}, agree {agree:.2f})  p50 {p50:7.2f} ms"
+            f"{mfu_s}  (compile {compile_s:.1f}s)"
         )
-    return spec, results
+        if mfu is not None and mfu > 1.0:
+            log(
+                f"batch {b:4d}: WARNING: MFU {mfu * 100:.0f}% > 100% -- measurement "
+                "is physically impossible and will be excluded from the headline"
+            )
+    return spec, results, flops_img
 
 
 def bench_serving(duration_s, clients, batcher_impl, max_delay_ms, buckets):
@@ -230,6 +326,10 @@ def main() -> int:
         help="serving-bench batching queue implementation",
     )
     p.add_argument("--max-delay-ms", type=float, default=2.0)
+    p.add_argument(
+        "--peak-tflops", type=float, default=0.0,
+        help="device peak TFLOP/s for MFU (0 = auto-detect from device kind)",
+    )
     args = p.parse_args()
 
     if args.serving > 0:
@@ -242,28 +342,50 @@ def main() -> int:
         )
 
     batch_sizes = [int(b) for b in args.batches.split(",")]
-    spec, results = bench_forward(
+    spec, results, flops_img = bench_forward(
         args.model, batch_sizes, args.scan_len, args.reps, args.dtype,
-        args.params_dtype,
+        args.params_dtype, args.peak_tflops,
     )
 
     # Headline: the north star is ">=4000 img/s/chip at p50 <= 15 ms"
-    # (BASELINE.json) -- so report the best throughput among batch sizes
-    # that MEET the latency bound, not a fixed batch.  The full sweep
-    # (including batch=32, measurement config 2) is on stderr above.
-    eligible = {b: r for b, r in results.items() if r["p50_ms"] <= TARGET_P50_MS}
-    pool = eligible or results  # nothing meets the bound: report best anyway
+    # (BASELINE.json) -- the best MIN-of-both-methods throughput among batch
+    # sizes that MEET the latency bound AND pass the physics check
+    # (MFU <= 100% when peak is known).  Full sweep is on stderr above.
+    def valid(r):
+        return r["mfu_pct"] is None or r["mfu_pct"] <= 100.0
+
+    valid_pool = {b: r for b, r in results.items() if valid(r)}
+    eligible = {
+        b: r for b, r in valid_pool.items() if r["p50_ms"] <= TARGET_P50_MS
+    }
+    pool = eligible or valid_pool or results
     headline_batch = max(pool, key=lambda b: pool[b]["img_per_s"])
     r = results[headline_batch]
     value = r["img_per_s"]
+    if not valid_pool:
+        bound_note = (
+            "INVALID: every batch failed the MFU<=100% physics check; "
+            "number is not trustworthy"
+        )
+    elif headline_batch in eligible:
+        bound_note = f"within p50<={TARGET_P50_MS:.0f}ms bound"
+    else:
+        bound_note = (
+            f"NO valid batch met the p50<={TARGET_P50_MS:.0f}ms bound; "
+            "best valid overall"
+        )
     out = {
         "metric": f"{spec.name} images/sec/chip (best batch={headline_batch} "
-        f"within p50<={TARGET_P50_MS:.0f}ms bound; device p50="
-        f"{r['p50_ms']:.2f}ms/batch, {args.dtype} compute, "
-        f"{args.params_dtype} params)",
+        f"{bound_note}; min of chained-scan/"
+        f"pipelined methods, agreement={r['method_agreement']:.2f}; device "
+        f"p50={r['p50_ms']:.2f}ms/batch, {args.dtype} compute, "
+        f"{args.params_dtype} params"
+        + (f", {flops_img / 1e9:.2f} GFLOPs/img" if flops_img else "")
+        + ")",
         "value": round(value, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(value / TARGET_IMG_S, 3),
+        "mfu_pct": r["mfu_pct"],
     }
     print(json.dumps(out), flush=True)
     return 0

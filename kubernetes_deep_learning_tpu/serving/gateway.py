@@ -47,6 +47,10 @@ PER_IMAGE_TIMEOUT_S = 0.25   # extra upstream budget per batched image: a
 UPSTREAM_RETRY_BACKOFF_S = 0.05  # one retry on the model tier's 503 overload
 MAX_BATCH_FETCHERS = 8       # concurrent image downloads per batch request
 MAX_URLS_PER_REQUEST = 256   # hard cap: bounds per-request image memory
+MAX_PREDICT_BODY_BYTES = 4 * 1024 * 1024  # /predict bodies are JSON of up to
+# 256 URLs -- a few KB each covers any sane client; checked against
+# Content-Length BEFORE reading so an adversarial multi-GB body cannot
+# exhaust gateway memory (the model tier has the equivalent pre-read cap).
 
 
 class UpstreamError(RuntimeError):
@@ -259,6 +263,24 @@ class Gateway:
             return 200, self.registry.render().encode(), "text/plain"
         return 404, b'{"error": "not found"}', "application/json"
 
+    def reject_oversize(self, length: int) -> tuple[int, bytes, str] | None:
+        """Pre-read Content-Length check shared by both transports; returns
+        the 413 response when the declared body exceeds the cap, else None.
+        Negative lengths are rejected too: rfile.read(-1) would read until
+        connection close, which is exactly the unbounded buffering the cap
+        exists to prevent."""
+        if length < 0 or length > MAX_PREDICT_BODY_BYTES:
+            self._m_errors.inc()
+            return (
+                413,
+                json.dumps({
+                    "error": f"request body {length} bytes exceeds the "
+                    f"{MAX_PREDICT_BODY_BYTES}-byte limit"
+                }).encode(),
+                "application/json",
+            )
+        return None
+
     def handle_predict(self, body: bytes) -> tuple[int, bytes, str]:
         """POST /predict body -> (status, body, content_type), instrumented."""
         t0 = time.perf_counter()
@@ -307,6 +329,12 @@ class Gateway:
                 if self.path != "/predict":
                     return self._send(404, b'{"error": "not found"}', "application/json")
                 length = int(self.headers.get("Content-Length", 0))
+                rejected = gw.reject_oversize(length)
+                if rejected is not None:
+                    # The unread body is still in the socket; close rather
+                    # than let keep-alive parse gigabytes as a next request.
+                    self.close_connection = True
+                    return self._send(*rejected)
                 self._send(*gw.handle_predict(self.rfile.read(length)))
 
         return Handler

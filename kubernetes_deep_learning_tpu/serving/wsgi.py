@@ -44,9 +44,14 @@ class GatewayWSGI:
             code, body, ctype = self.gateway.handle_get(path)
         elif method == "POST" and path == "/predict":
             length = int(environ.get("CONTENT_LENGTH") or 0)
-            code, body, ctype = self.gateway.handle_predict(
-                environ["wsgi.input"].read(length)
-            )
+            rejected = self.gateway.reject_oversize(length)
+            if rejected is not None:
+                code, body, ctype = rejected  # body stays unread; gunicorn
+                # discards the connection on its own
+            else:
+                code, body, ctype = self.gateway.handle_predict(
+                    environ["wsgi.input"].read(length)
+                )
         else:
             code, body, ctype = 404, b'{"error": "not found"}', "application/json"
         start_response(

@@ -164,6 +164,13 @@ def image_folder_batches(
                 samples.append((path, label_to_index[entry]))
     if not samples:
         raise FileNotFoundError(f"no class directories with images under {root!r}")
+    if drop_remainder and len(samples) < batch:
+        # Fail loudly: every epoch would yield nothing, and with epochs=None
+        # the generator would busy-spin forever inside fit()'s next().
+        raise ValueError(
+            f"drop_remainder=True but only {len(samples)} sample(s) under "
+            f"{root!r} < batch={batch}: every epoch would yield zero batches"
+        )
 
     rng = np.random.default_rng(seed)
     size = spec.input_shape[:2]

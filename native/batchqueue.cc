@@ -221,15 +221,25 @@ int kdlt_bq_take(void* handle, uint8_t* dst, int max_batch,
       taken.push_back(idx);
     }
   }
+  // Tickets are computed under the lock: gen is stable for slots this
+  // thread just marked kInflight, but an abort() racing this point marks
+  // them kFailed, and a waking waiter then frees them (gen++ under the
+  // lock) -- reading gen after unlock would be an unsynchronized
+  // read/write race with that increment.
+  for (size_t i = 0; i < taken.size(); ++i)
+    tickets[i] = ticket_of(*q, taken[i], q->slots[taken[i]].gen);
   // Assemble with the lock released: in-flight slots are owned by the
   // dispatcher, so a large batch gather never blocks submitters.  The
   // active guard (still held) keeps destroy() from freeing slots under us.
+  // The unlocked image reads cannot race a writer: image bytes are written
+  // only by submit(), which requires a free slot, and an inflight slot can
+  // only become free via abort()/destroy() -- both of which also close the
+  // queue, so no submit can follow.  (If the slot IS freed mid-gather, the
+  // stale bytes are copied but complete() drops the row on gen mismatch.)
   lk.unlock();
   for (size_t i = 0; i < taken.size(); ++i) {
-    const Slot& s = q->slots[taken[i]];
-    std::memcpy(dst + static_cast<int64_t>(i) * q->item_bytes, s.image.data(),
-                q->item_bytes);
-    tickets[i] = ticket_of(*q, taken[i], s.gen);
+    std::memcpy(dst + static_cast<int64_t>(i) * q->item_bytes,
+                q->slots[taken[i]].image.data(), q->item_bytes);
   }
   lk.lock();
   guard.release(lk);

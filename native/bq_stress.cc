@@ -13,6 +13,7 @@
 // Exit code 0 = every invariant held under the sanitizer.
 
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -30,6 +31,7 @@ void kdlt_bq_complete(void* q, const int64_t* tickets, int n,
 void kdlt_bq_fail(void* q, const int64_t* tickets, int n);
 int kdlt_bq_wait(void* q, int64_t ticket, float* out, double timeout_s);
 void kdlt_bq_close(void* q);
+void kdlt_bq_abort(void* q);
 }
 
 namespace {
@@ -99,7 +101,12 @@ void dispatcher(void* q) {
 
 }  // namespace
 
-int main() {
+// abort_mid_load=false: drain-close while producers still submit (late
+// submits must see -2, queued work must still be served).  true: abort
+// while the dispatcher is mid-take/mid-complete -- this is the race the
+// advisor flagged (take's gather vs abort-triggered slot frees); waiters
+// must resolve with rc=2, never a torn ticket.
+int run_scenario(bool abort_mid_load) {
   void* q = kdlt_bq_create(kCapacity, kItemBytes, kOutFloats);
   if (!q) {
     std::fprintf(stderr, "create failed\n");
@@ -108,19 +115,35 @@ int main() {
   std::thread disp(dispatcher, q);
   std::vector<std::thread> prods;
   for (int i = 0; i < kProducers; ++i) prods.emplace_back(producer, q, i);
-  // Drain-close while some producers are likely still submitting: late
-  // submits must see -2, queued work must still be served.
-  prods[0].join();
-  kdlt_bq_close(q);
-  for (size_t i = 1; i < prods.size(); ++i) prods[i].join();
+  if (abort_mid_load) {
+    // Abort as early as possible while traffic is at full blast: no join
+    // first, just a tiny sleep so slots are pending AND inflight.
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    kdlt_bq_abort(q);
+    for (auto& p : prods) p.join();
+  } else {
+    prods[0].join();
+    kdlt_bq_close(q);
+    for (size_t i = 1; i < prods.size(); ++i) prods[i].join();
+  }
   disp.join();
   kdlt_bq_destroy(q);
 
   std::printf(
-      "ok=%ld timeouts=%ld failed=%ld rejected=%ld closed=%ld mismatches=%ld\n",
-      ok.load(), timeouts.load(), failed.load(), rejected.load(), closed.load(),
-      mismatches.load());
+      "%s: ok=%ld timeouts=%ld failed=%ld rejected=%ld closed=%ld "
+      "mismatches=%ld\n",
+      abort_mid_load ? "abort" : "drain", ok.load(), timeouts.load(),
+      failed.load(), rejected.load(), closed.load(), mismatches.load());
   if (mismatches.load() != 0) return 1;
-  if (ok.load() == 0) return 1;  // the harness must exercise the happy path
+  // The drain scenario must exercise the happy path; the abort scenario may
+  // legitimately kill everything before any completion lands.
+  if (!abort_mid_load && ok.load() == 0) return 1;
+  return 0;
+}
+
+int main() {
+  if (int rc = run_scenario(false)) return rc;
+  ok = timeouts = failed = rejected = closed = mismatches = 0;
+  if (int rc = run_scenario(true)) return rc;
   return 0;
 }

@@ -210,6 +210,20 @@ def test_image_folder_batches(tmp_path, ckpt_spec):
     assert int(state.step) == 2
 
 
+def test_image_folder_too_few_samples_fails_loudly(tmp_path, ckpt_spec):
+    """drop_remainder with fewer samples than one batch must raise, not
+    busy-spin forever inside fit()'s next() (ADVICE r1)."""
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.training.data import image_folder_batches
+
+    d = tmp_path / "a"
+    d.mkdir()
+    Image.fromarray(np.zeros((8, 8, 3), np.uint8), "RGB").save(d / "x.png")
+    with pytest.raises(ValueError, match="zero batches"):
+        next(image_folder_batches(str(tmp_path), ckpt_spec, batch=8))
+
+
 def test_image_folder_rejects_unknown_label(tmp_path, ckpt_spec):
     from PIL import Image
 

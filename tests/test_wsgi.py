@@ -90,6 +90,36 @@ def test_wsgi_health_metrics_and_errors(wsgi_stack):
     assert "error" in r.json()
 
 
+def test_oversize_body_rejected_without_read():
+    """A declared multi-GB body is refused at the Content-Length check,
+    before any byte of the body is read (ADVICE r1: memory exhaustion)."""
+    from kubernetes_deep_learning_tpu.serving.gateway import MAX_PREDICT_BODY_BYTES
+
+    wsgi = GatewayWSGI(Gateway(bind=False))
+
+    class ExplodingInput:
+        def read(self, n=-1):
+            raise AssertionError("oversize body must not be read")
+
+    statuses = []
+    out = wsgi(
+        {
+            "REQUEST_METHOD": "POST",
+            "PATH_INFO": "/predict",
+            "CONTENT_LENGTH": str(MAX_PREDICT_BODY_BYTES + 1),
+            "wsgi.input": ExplodingInput(),
+        },
+        lambda status, headers: statuses.append(status),
+    )
+    assert statuses[0].startswith("413")
+    assert b"exceeds" in b"".join(out)
+    # At and below the cap is not rejected.
+    assert wsgi.gateway.reject_oversize(MAX_PREDICT_BODY_BYTES) is None
+    # Negative Content-Length would make rfile.read(-1) buffer until
+    # connection close -- must be rejected, not passed through.
+    assert wsgi.gateway.reject_oversize(-1) is not None
+
+
 def test_bind_false_has_no_listener():
     gw = Gateway(bind=False)
     assert gw._httpd is None
