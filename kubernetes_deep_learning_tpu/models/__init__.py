@@ -26,10 +26,20 @@ def create_model(spec: ModelSpec, dtype: Any = None):
         from kubernetes_deep_learning_tpu.models.resnet import ResNet50
 
         return ResNet50(spec.num_classes, dtype=dtype)
-    if spec.family == "efficientnet-b3":
-        from kubernetes_deep_learning_tpu.models.efficientnet import EfficientNetB3
+    if spec.family.startswith("efficientnet-"):
+        from kubernetes_deep_learning_tpu.models.efficientnet import (
+            SCALING,
+            build_efficientnet,
+        )
 
-        return EfficientNetB3(spec.num_classes, dtype=dtype)
+        variant = spec.family.removeprefix("efficientnet-")
+        if variant in SCALING:  # else fall through to the registry error
+            return build_efficientnet(
+                variant,
+                spec.num_classes,
+                head_hidden=spec.head_hidden,
+                dtype=dtype,
+            )
     if spec.family in _vit_families():
         from kubernetes_deep_learning_tpu.models.vit import VIT_CONFIGS, ViT
 

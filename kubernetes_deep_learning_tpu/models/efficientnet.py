@@ -152,7 +152,32 @@ class EfficientNet(nn.Module):
         )(x, train=train)
 
 
+# Compound-scaling table, Tan & Le 2019 table 1 / keras.applications:
+# variant -> (width, depth, dropout).  Native resolution is carried by the
+# ModelSpec's input_shape, not the module (any input size works).
+SCALING = {
+    "b0": (1.0, 1.0, 0.2),
+    "b1": (1.0, 1.1, 0.2),
+    "b2": (1.1, 1.2, 0.3),
+    "b3": (1.2, 1.4, 0.3),
+    "b4": (1.4, 1.8, 0.4),
+    "b5": (1.6, 2.2, 0.4),
+    "b6": (1.8, 2.6, 0.5),
+    "b7": (2.0, 3.1, 0.5),
+}
+
+
+def build_efficientnet(variant: str, num_classes: int, dtype: Any = None, **kw) -> EfficientNet:
+    """Any B0-B7 variant by name ("b0".."b7")."""
+    if variant not in SCALING:
+        raise KeyError(
+            f"unknown EfficientNet variant {variant!r}; supported: {sorted(SCALING)}"
+        )
+    width, depth, dropout = SCALING[variant]
+    kw.setdefault("dropout_rate", dropout)
+    return EfficientNet(num_classes, width=width, depth=depth, dtype=dtype, **kw)
+
+
 def EfficientNetB3(num_classes: int, dtype: Any = None, **kw) -> EfficientNet:
     """B3 compound scaling: width 1.2, depth 1.4, input 300x300, dropout 0.3."""
-    kw.setdefault("dropout_rate", 0.3)
-    return EfficientNet(num_classes, width=1.2, depth=1.4, dtype=dtype, **kw)
+    return build_efficientnet("b3", num_classes, dtype=dtype, **kw)

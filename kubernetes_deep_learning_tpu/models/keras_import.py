@@ -209,6 +209,92 @@ def resnet50_variables_from_keras(
     return variables
 
 
+_EFF_BLOCK_RE = re.compile(
+    r"block(\d+)([a-z])_"
+    r"(expand_conv|expand_bn|dwconv|bn|se_reduce|se_expand|project_conv|project_bn)"
+)
+
+
+def efficientnet_variables_from_keras(
+    spec: ModelSpec, layers: dict[str, dict[str, np.ndarray]]
+):
+    """Build flax variables for models.efficientnet from Keras weights.
+
+    keras.applications.EfficientNetB* names blocks ``block{stage}{letter}_*``
+    (block1a, block1b, block2a, ...); our module numbers them flat in the same
+    creation order (block0, block1, ...), so sorting the Keras names by
+    (stage, letter) and zipping is an exact rename.  The depthwise kernel
+    transposes (kh,kw,c,1) -> (kh,kw,1,c) as in ``_sepconv``; Keras's dw
+    BatchNorm is named bare ``_bn`` where ours is ``dw_bn``.
+
+    keras.applications builds Rescaling+Normalization INTO the model; those
+    layers are skipped here because the framework normalizes outside the
+    model (ops.preprocess), so the spec must say ``preprocessing="torch"``
+    (the equivalent recipe) or logits will not match the Keras model.
+    """
+    # Keras auto-numbers repeated layer instances (normalization_1, ...) when
+    # several models were built in one session before saving.
+    has_norm = any(
+        n == "normalization" or n.startswith("normalization_") for n in layers
+    )
+    if has_norm and spec.preprocessing != "torch":
+        raise ValueError(
+            ".h5 contains a keras Normalization layer (EfficientNet-style "
+            "built-in preprocessing) but the spec's preprocessing is "
+            f"{spec.preprocessing!r}; use 'torch' for logit parity"
+        )
+
+    params: dict = {}
+    stats: dict = {}
+
+    def put_bn(tree_p, tree_s, name: str, layer):
+        p, s = _bn(layer)
+        tree_p[name] = p
+        tree_s[name] = s
+
+    params["stem_conv"] = {"kernel": layers["stem_conv"]["kernel"]}
+    put_bn(params, stats, "stem_bn", layers["stem_bn"])
+    params["top_conv"] = {"kernel": layers["top_conv"]["kernel"]}
+    put_bn(params, stats, "top_bn", layers["top_bn"])
+
+    blocks: dict[tuple[int, str], dict[str, dict[str, np.ndarray]]] = {}
+    for name, w in layers.items():
+        if m := _EFF_BLOCK_RE.fullmatch(name):
+            blocks.setdefault((int(m.group(1)), m.group(2)), {})[m.group(3)] = w
+
+    for i, key in enumerate(sorted(blocks)):
+        sub = blocks[key]
+        bp: dict = {}
+        bs: dict = {}
+        if "expand_conv" in sub:
+            bp["expand_conv"] = {"kernel": sub["expand_conv"]["kernel"]}
+            put_bn(bp, bs, "expand_bn", sub["expand_bn"])
+        dw = sub["dwconv"]["depthwise_kernel"]  # keras (kh, kw, c, 1)
+        bp["dwconv"] = {"kernel": np.transpose(dw, (0, 1, 3, 2))}
+        put_bn(bp, bs, "dw_bn", sub["bn"])
+        if "se_reduce" in sub:
+            bp["se"] = {
+                "reduce": {
+                    "kernel": sub["se_reduce"]["kernel"],
+                    "bias": sub["se_reduce"]["bias"],
+                },
+                "expand": {
+                    "kernel": sub["se_expand"]["kernel"],
+                    "bias": sub["se_expand"]["bias"],
+                },
+            }
+        bp["project_conv"] = {"kernel": sub["project_conv"]["kernel"]}
+        put_bn(bp, bs, "project_bn", sub["project_bn"])
+        params[f"block{i}"] = bp
+        stats[f"block{i}"] = bs
+
+    params["head"] = _head_from_denses(spec, layers)
+
+    variables = {"params": params, "batch_stats": stats}
+    _check_structure(spec, variables)
+    return variables
+
+
 def _check_structure(spec: ModelSpec, variables) -> None:
     """Verify imported tree matches the module's own init structure."""
     import jax
@@ -241,4 +327,6 @@ def load_keras_h5(spec: ModelSpec, path: str):
         return xception_variables_from_keras(spec, layers)
     if spec.family == "resnet50":
         return resnet50_variables_from_keras(spec, layers)
+    if spec.family.startswith("efficientnet-"):
+        return efficientnet_variables_from_keras(spec, layers)
     raise NotImplementedError(f"Keras import not implemented for {spec.family!r}")

@@ -31,6 +31,12 @@ class ModelSpec:
     output_name: str = "scores"     # response tensor key
     head_hidden: tuple[int, ...] = ()   # hidden Dense sizes between pool and logits
     description: str = ""
+    # Legacy tensor names from the reference's SavedModel signature
+    # (reference guide.md:220-231: input_8/dense_7), accepted/emitted by the
+    # gRPC PredictionService frontend so reference-era gRPC clients
+    # (reference model_server.py:35-49) work against this server unmodified.
+    compat_input_name: str = ""
+    compat_output_name: str = ""
 
     @property
     def num_classes(self) -> int:
@@ -103,6 +109,8 @@ CLOTHING_MODEL = register_spec(
         resize_filter="nearest",
         head_hidden=(100,),
         description="Xception clothing classifier (reference flagship model)",
+        compat_input_name="input_8",
+        compat_output_name="dense_7",
     )
 )
 

@@ -516,6 +516,14 @@ def main(argv: list[str] | None = None) -> int:
         help="disable the /debug/profile endpoint",
     )
     p.add_argument(
+        "--grpc-port",
+        type=int,
+        default=-1,
+        help="ALSO serve the TF-Serving-compatible gRPC PredictionService on "
+        "this port (-1 = off, 0 = ephemeral; the reference's model tier is "
+        "gRPC on 8500, reference tf-serving-clothing-model-service.yaml:9-10)",
+    )
+    p.add_argument(
         "--watch-interval",
         type=float,
         default=10.0,
@@ -572,8 +580,18 @@ def main(argv: list[str] | None = None) -> int:
     server.warmup()
     if args.watch_interval > 0:
         server.start_version_watcher(args.watch_interval)
+    grpc_server = None
+    if args.grpc_port >= 0:
+        from kubernetes_deep_learning_tpu.serving.grpc_predict import serve_grpc
+
+        grpc_server, grpc_port = serve_grpc(server, args.grpc_port)
+        print(f"gRPC PredictionService listening on :{grpc_port}")
     print(f"model server listening on :{server.port}")
-    server.start(block=True)
+    try:
+        server.start(block=True)
+    finally:
+        if grpc_server is not None:
+            grpc_server.stop(grace=5)
     return 0
 
 

@@ -1,0 +1,233 @@
+"""gRPC PredictionService wire-compatibility tests.
+
+The client side of each test marshals a PredictRequest exactly the way the
+reference gateway does (reference model_server.py:35-55): model_spec.name +
+signature_name='serving_default', the input under the SavedModel signature's
+tensor name, data as tf.make_tensor_proto would emit it (raw little-endian
+tensor_content for a non-empty float32 array), a 20 s deadline, and the
+response read back through ``outputs[...].float_val``.  No TensorFlow is in
+the loop -- the protos are the hand-written wire-compatible subset in
+serving/tfs_protos.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import grpc
+
+from kubernetes_deep_learning_tpu.export import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.serving.grpc_predict import (
+    SERVICE_NAME,
+    array_from_tensor_proto,
+    serve_grpc,
+    tensor_proto_from_array,
+)
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+    predict_pb2,
+)
+
+
+@pytest.fixture(scope="module")
+def grpc_stack(tmp_path_factory):
+    spec = register_spec(
+        ModelSpec(
+            name="grpc-xception",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("dress", "hat", "pants", "shirt"),
+            preprocessing="tf",
+            # The reference SavedModel's signature tensor names
+            # (reference guide.md:220-231).
+            compat_input_name="input_8",
+            compat_output_name="dense_7",
+        )
+    )
+    root = tmp_path_factory.mktemp("models")
+    variables = init_variables(spec, seed=11)
+    export_model(spec, variables, str(root), dtype=np.float32)
+
+    server = ModelServer(str(root), port=0, buckets=(1, 2, 4), max_delay_ms=1.0)
+    server.warmup()
+    grpc_server, port = serve_grpc(server, 0, host="127.0.0.1")
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    # The reference builds its stub from protoc-generated service code
+    # (PredictionServiceStub, reference model_server.py:16); the multicallable
+    # below is the identical wire operation -- same method path, same
+    # serialized bytes.
+    predict = channel.unary_unary(
+        f"/{SERVICE_NAME}/Predict",
+        request_serializer=predict_pb2.PredictRequest.SerializeToString,
+        response_deserializer=predict_pb2.PredictResponse.FromString,
+    )
+    yield spec, server, predict
+
+    channel.close()
+    grpc_server.stop(grace=None)
+    server.shutdown()
+
+
+def _reference_style_request(spec, X: np.ndarray) -> predict_pb2.PredictRequest:
+    """Marshal as reference model_server.py:39-43 does (make_request)."""
+    req = predict_pb2.PredictRequest()
+    req.model_spec.name = spec.name
+    req.model_spec.signature_name = "serving_default"
+    # tf.make_tensor_proto(X, shape=X.shape) on float32 emits tensor_content.
+    req.inputs["input_8"].CopyFrom(tensor_proto_from_array(X, use_content=True))
+    return req
+
+
+def test_reference_client_marshalling_roundtrip(grpc_stack):
+    spec, server, predict = grpc_stack
+    rng = np.random.default_rng(0)
+    # The reference gateway sends preprocessed float32 ("tf" mode: [-1, 1]).
+    X = rng.uniform(-1.0, 1.0, size=(1, *spec.input_shape)).astype(np.float32)
+
+    result = predict(_reference_style_request(spec, X), timeout=20.0)
+
+    # Reference response handling (model_server.py:46-49): float_val under
+    # the SavedModel output tensor name.
+    pred = result.outputs["dense_7"].float_val
+    assert len(pred) == spec.num_classes
+    expected = server.models[spec.name].engine.predict(X)
+    np.testing.assert_allclose(
+        np.array(pred).reshape(1, -1), expected, rtol=1e-5, atol=1e-5
+    )
+    # The spec-native output key carries the same tensor.
+    np.testing.assert_array_equal(
+        result.outputs["dense_7"].float_val, result.outputs[spec.output_name].float_val
+    )
+    assert result.model_spec.version.value >= 1
+
+
+def test_uint8_content_and_shapes(grpc_stack):
+    """uint8 wire path (this framework's preferred dtype) over gRPC."""
+    spec, server, predict = grpc_stack
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 256, size=(3, *spec.input_shape), dtype=np.uint8)
+    req = predict_pb2.PredictRequest()
+    req.model_spec.name = spec.name
+    req.inputs[spec.input_name].CopyFrom(
+        tensor_proto_from_array(images, use_content=True)
+    )
+    result = predict(req, timeout=20.0)
+    got = np.array(result.outputs[spec.output_name].float_val).reshape(3, -1)
+    np.testing.assert_allclose(
+        got, server.models[spec.name].engine.predict(images), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_float_val_and_broadcast_marshalling(grpc_stack):
+    """Packed float_val requests and the single-element broadcast convention."""
+    spec, server, predict = grpc_stack
+    rng = np.random.default_rng(2)
+    X = rng.uniform(-1, 1, size=(1, *spec.input_shape)).astype(np.float32)
+    req = _reference_style_request(spec, X)
+    req.inputs["input_8"].CopyFrom(tensor_proto_from_array(X))  # float_val form
+    a = np.array(predict(req, timeout=20.0).outputs[spec.output_name].float_val)
+
+    expected = server.models[spec.name].engine.predict(X)
+    np.testing.assert_allclose(a.reshape(1, -1), expected, rtol=1e-5, atol=1e-5)
+
+    # Broadcast: one value + full shape (tf.make_tensor_proto scalar form).
+    tp = req.inputs["input_8"]
+    del tp.float_val[:]
+    tp.ClearField("tensor_content")
+    tp.float_val.append(0.25)
+    b = np.array(predict(req, timeout=20.0).outputs[spec.output_name].float_val)
+    const = np.full((1, *spec.input_shape), 0.25, np.float32)
+    np.testing.assert_allclose(
+        b.reshape(1, -1),
+        server.models[spec.name].engine.predict(const),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_int32_pixels_normalize_like_uint8(grpc_stack):
+    """Integer tensors are pixels: they must take the normalize-on-device
+    path, not be misread as pre-normalized floats (tf.make_tensor_proto
+    emits DT_INT32 for plain Python int lists)."""
+    spec, server, predict = grpc_stack
+    rng = np.random.default_rng(5)
+    pixels = rng.integers(0, 256, size=(1, *spec.input_shape), dtype=np.int32)
+    req = predict_pb2.PredictRequest()
+    req.model_spec.name = spec.name
+    req.inputs[spec.input_name].CopyFrom(tensor_proto_from_array(pixels))
+    got = np.array(predict(req, timeout=20.0).outputs[spec.output_name].float_val)
+    expected = server.models[spec.name].engine.predict(pixels.astype(np.uint8))
+    np.testing.assert_allclose(got.reshape(1, -1), expected, rtol=1e-5, atol=1e-5)
+
+    req.inputs[spec.input_name].CopyFrom(
+        tensor_proto_from_array(pixels + 300)  # out of pixel range
+    )
+    with pytest.raises(grpc.RpcError) as e:
+        predict(req, timeout=20.0)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_grpc_error_statuses(grpc_stack):
+    spec, _, predict = grpc_stack
+    rng = np.random.default_rng(3)
+    X = rng.uniform(-1, 1, size=(1, *spec.input_shape)).astype(np.float32)
+
+    req = _reference_style_request(spec, X)
+    req.model_spec.name = "no-such-model"
+    with pytest.raises(grpc.RpcError) as e:
+        predict(req, timeout=20.0)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+    req = _reference_style_request(spec, X)
+    req.model_spec.signature_name = "wrong_signature"
+    with pytest.raises(grpc.RpcError) as e:
+        predict(req, timeout=20.0)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    bad = rng.uniform(-1, 1, size=(1, 32, 32, 3)).astype(np.float32)
+    with pytest.raises(grpc.RpcError) as e:
+        predict(_reference_style_request(spec, bad), timeout=20.0)
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_tensor_proto_numpy_roundtrip():
+    rng = np.random.default_rng(4)
+    for arr in (
+        rng.normal(size=(2, 3, 4)).astype(np.float32),
+        rng.integers(0, 256, size=(5, 7), dtype=np.uint8),
+        rng.normal(size=(3,)).astype(np.float64),
+        rng.integers(-100, 100, size=(2, 2), dtype=np.int64),
+        rng.normal(size=(4, 2)).astype(np.float16),
+    ):
+        for use_content in (False, True):
+            tp = tensor_proto_from_array(arr, use_content=use_content)
+            back = array_from_tensor_proto(tp)
+            assert back.dtype == arr.dtype and back.shape == arr.shape
+            np.testing.assert_array_equal(back, arr)
+
+
+def test_modelspec_compat_fields_roundtrip():
+    spec = ModelSpec(
+        name="s",
+        family="xception",
+        input_shape=(96, 96, 3),
+        labels=("a", "b"),
+        compat_input_name="input_8",
+        compat_output_name="dense_7",
+    )
+    again = ModelSpec.from_json(spec.to_json())
+    assert again == spec
+    # Old artifacts (round-1 spec.json without the compat fields) still load.
+    legacy = dataclasses.asdict(spec)
+    legacy.pop("compat_input_name")
+    legacy.pop("compat_output_name")
+    import json as _json
+
+    old = ModelSpec.from_json(_json.dumps(legacy))
+    assert old.compat_input_name == "" and old.compat_output_name == ""

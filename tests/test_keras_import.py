@@ -196,6 +196,137 @@ def test_resnet50_h5_roundtrip_bitexact(tmp_path):
     )
 
 
+def _keras_eff_block_names(variant):
+    """Flat block index -> keras 'block{stage}{letter}' name, creation order."""
+    from kubernetes_deep_learning_tpu.models.efficientnet import (
+        _BASE_BLOCKS,
+        SCALING,
+        round_repeats,
+    )
+
+    _, depth, _ = SCALING[variant]
+    names = []
+    for stage, (_, _, repeats, _, _) in enumerate(_BASE_BLOCKS, start=1):
+        for rep in range(round_repeats(repeats, depth)):
+            names.append(f"block{stage}{chr(ord('a') + rep)}")
+    return names
+
+
+def _flax_efficientnet_to_keras_h5(path, variant, variables):
+    """Write flax EfficientNet variables as a keras.applications-style .h5."""
+    import h5py
+
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def bn_entry(p, s):
+        return {
+            "gamma": p["scale"], "beta": p["bias"],
+            "moving_mean": s["mean"], "moving_variance": s["var"],
+        }
+
+    entries = {
+        "stem_conv": {"kernel": params["stem_conv"]["kernel"]},
+        "stem_bn": bn_entry(params["stem_bn"], stats["stem_bn"]),
+        "top_conv": {"kernel": params["top_conv"]["kernel"]},
+        "top_bn": bn_entry(params["top_bn"], stats["top_bn"]),
+    }
+    head = params["head"]
+    hidden = sorted(k for k in head if k.startswith("hidden_"))
+    if hidden:  # fine-tuned head: auto-named Dense chain, last one = logits
+        for i, h in enumerate(hidden):
+            entries[f"dense_{i}" if i else "dense"] = {
+                "kernel": head[h]["kernel"], "bias": head[h]["bias"]
+            }
+        entries[f"dense_{len(hidden)}"] = {
+            "kernel": head["logits"]["kernel"], "bias": head["logits"]["bias"]
+        }
+    else:  # stock ImageNet head
+        entries["predictions"] = {
+            "kernel": head["logits"]["kernel"], "bias": head["logits"]["bias"]
+        }
+    knames = _keras_eff_block_names(variant)
+    for i, kname in enumerate(knames):
+        bp, bs = params[f"block{i}"], stats[f"block{i}"]
+        if "expand_conv" in bp:
+            entries[f"{kname}_expand_conv"] = {"kernel": bp["expand_conv"]["kernel"]}
+            entries[f"{kname}_expand_bn"] = bn_entry(bp["expand_bn"], bs["expand_bn"])
+        # keras stores depthwise kernels (kh, kw, c, 1); flax (kh, kw, 1, c)
+        entries[f"{kname}_dwconv"] = {
+            "depthwise_kernel": np.transpose(np.asarray(bp["dwconv"]["kernel"]), (0, 1, 3, 2))
+        }
+        entries[f"{kname}_bn"] = bn_entry(bp["dw_bn"], bs["dw_bn"])
+        entries[f"{kname}_se_reduce"] = {
+            "kernel": bp["se"]["reduce"]["kernel"], "bias": bp["se"]["reduce"]["bias"]
+        }
+        entries[f"{kname}_se_expand"] = {
+            "kernel": bp["se"]["expand"]["kernel"], "bias": bp["se"]["expand"]["bias"]
+        }
+        entries[f"{kname}_project_conv"] = {"kernel": bp["project_conv"]["kernel"]}
+        entries[f"{kname}_project_bn"] = bn_entry(bp["project_bn"], bs["project_bn"])
+    with h5py.File(path, "w") as f:
+        root = f.create_group("model_weights")
+        for layer, weights in entries.items():
+            g = root.create_group(layer)
+            for wname, arr in weights.items():
+                g.create_dataset(f"{wname}:0", data=np.asarray(arr))
+
+
+@pytest.mark.parametrize("variant", ["b0", "b3"])
+def test_efficientnet_h5_roundtrip_bitexact(tmp_path, variant):
+    # b0 also covers a fine-tuned hidden head (the clothing-model shape);
+    # b3 covers the served BASELINE config-4 family's deeper repeat counts.
+    spec = register_spec(
+        ModelSpec(
+            name=f"h5-eff-{variant}",
+            family=f"efficientnet-{variant}",
+            input_shape=(64, 64, 3),
+            labels=("a", "b", "c"),
+            preprocessing="torch",
+            head_hidden=(16,) if variant == "b0" else (),
+        )
+    )
+    variables = init_variables(spec, seed=5)
+    path = tmp_path / "eff.h5"
+    _flax_efficientnet_to_keras_h5(str(path), variant, variables)
+    imported = load_keras_h5(spec, str(path))
+
+    flat_a, tree_a = jax.tree_util.tree_flatten(variables)
+    flat_b, tree_b = jax.tree_util.tree_flatten(imported)
+    assert tree_a == tree_b
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    fwd = jax.jit(build_forward(spec, dtype=None))
+    x = np.random.default_rng(1).integers(0, 256, (2, 64, 64, 3), np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(fwd(variables, x)), np.asarray(fwd(imported, x))
+    )
+
+
+def test_efficientnet_h5_rejects_non_torch_preprocessing(tmp_path):
+    """A keras Normalization layer in the .h5 demands spec.preprocessing='torch'."""
+    import h5py
+
+    spec = register_spec(
+        ModelSpec(
+            name="h5-eff-badpre",
+            family="efficientnet-b0",
+            input_shape=(64, 64, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",  # wrong: keras EfficientNet normalizes in-model
+        )
+    )
+    variables = init_variables(spec, seed=0)
+    path = tmp_path / "eff.h5"
+    _flax_efficientnet_to_keras_h5(str(path), "b0", variables)
+    with h5py.File(path, "a") as f:
+        g = f["model_weights"].create_group("normalization")
+        g.create_dataset("mean:0", data=np.array([0.485, 0.456, 0.406]))
+        g.create_dataset("variance:0", data=np.array([0.052, 0.050, 0.051]))
+    with pytest.raises(ValueError, match="preprocessing"):
+        load_keras_h5(spec, str(path))
+
+
 def test_resnet50_h5_rejects_wrong_head(tmp_path):
     from kubernetes_deep_learning_tpu.models import init_variables
     from kubernetes_deep_learning_tpu.models.keras_import import load_keras_h5
