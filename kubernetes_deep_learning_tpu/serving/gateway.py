@@ -32,7 +32,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.ops import preprocess
+from kubernetes_deep_learning_tpu.runtime import QueueFull
 from kubernetes_deep_learning_tpu.serving import protocol
+from kubernetes_deep_learning_tpu.serving.tracing import (
+    REQUEST_ID_HEADER,
+    ensure_request_id,
+    log_request,
+)
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 DEFAULT_PORT = 9696          # reference gateway port (gateway.dockerfile:15-16)
@@ -69,7 +75,29 @@ class Gateway:
         port: int = DEFAULT_PORT,
         host: str = "0.0.0.0",
         bind: bool = True,
+        request_log: bool = False,
+        upstream_batch: int = 0,
+        upstream_delay_ms: float = 2.0,
     ):
+        # request_log: print one traced line per /predict (rid, status,
+        # duration).  Off by default for in-process use (tests, benches);
+        # the CLI turns it on.  Errors are always logged, with the rid.
+        self.request_log = request_log
+        # upstream_batch > 0: coalesce concurrent single-image requests into
+        # one upstream predict of up to this size (serving.microbatch) --
+        # the model tier then sees few, fat requests.  0 = one upstream call
+        # per request (the reference's shape, model_server.py:55).
+        self._microbatcher = None
+        if upstream_batch > 0:
+            from kubernetes_deep_learning_tpu.serving.microbatch import (
+                UpstreamMicroBatcher,
+            )
+
+            self._microbatcher = UpstreamMicroBatcher(
+                self._predict_batch,
+                max_batch=upstream_batch,
+                max_delay_ms=upstream_delay_ms,
+            )
         # bind=False skips the in-tree HTTP server entirely: serving.wsgi
         # wraps this object under an external WSGI server (gunicorn) instead,
         # the reference's production-server arrangement.
@@ -149,7 +177,7 @@ class Gateway:
         self._m_fetch.observe(time.perf_counter() - t0)
         return image
 
-    def _predict_batch(self, images) -> tuple[list, list[str]]:
+    def _predict_batch(self, images, request_id: str = "") -> tuple[list, list[str]]:
         """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
 
         One retry on 503: that status is the model tier's explicit transient
@@ -171,10 +199,13 @@ class Gateway:
             if attempt:
                 time.sleep(UPSTREAM_RETRY_BACKOFF_S)
             try:
+                headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
+                if request_id:  # cross-tier trace propagation
+                    headers[REQUEST_ID_HEADER] = request_id
                 r = self._session().post(
                     f"{self._base}/v1/models/{self.model}:predict",
                     data=body,
-                    headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+                    headers=headers,
                     timeout=timeout,
                 )
             except requests.RequestException as e:
@@ -196,14 +227,17 @@ class Gateway:
             raise UpstreamError(f"malformed model server response: {e}") from e
         return logits, labels
 
-    def apply_model(self, url: str) -> dict[str, float]:
+    def apply_model(self, url: str, request_id: str = "") -> dict[str, float]:
         """url -> {label: score}; the reference's apply_model
         (reference model_server.py:52-56)."""
         image = self._fetch_one(url)
-        logits, labels = self._predict_batch(image[None])
+        if self._microbatcher is not None:
+            row, labels = self._microbatcher.predict(image, request_id)
+            return dict(zip(labels, map(float, row)))
+        logits, labels = self._predict_batch(image[None], request_id)
         return dict(zip(labels, map(float, logits[0])))
 
-    def apply_model_batch(self, urls: list[str]) -> list[dict]:
+    def apply_model_batch(self, urls: list[str], request_id: str = "") -> list[dict]:
         """urls -> per-url {label: score} or {"error": ...}, order-preserving.
 
         Beyond-reference extension: fetches run concurrently (IO-bound) and
@@ -231,7 +265,9 @@ class Gateway:
         if good:
             import numpy as np
 
-            logits, labels = self._predict_batch(np.stack([img for _, img in good]))
+            logits, labels = self._predict_batch(
+                np.stack([img for _, img in good]), request_id
+            )
             for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
         return results
@@ -281,28 +317,54 @@ class Gateway:
             )
         return None
 
-    def handle_predict(self, body: bytes) -> tuple[int, bytes, str]:
-        """POST /predict body -> (status, body, content_type), instrumented."""
+    def handle_predict(
+        self, body: bytes, request_id: str | None = None
+    ) -> tuple[int, bytes, str]:
+        """POST /predict body -> (status, body, content_type), instrumented.
+
+        ``request_id`` is the (already-sanitized) cross-tier trace id; both
+        transports mint/sanitize it via tracing.ensure_request_id before
+        calling here so the id in the response header, the upstream call,
+        and the log line is the same one.
+        """
         t0 = time.perf_counter()
+        rid = request_id or ensure_request_id(None)
         self._m_requests.inc()
+        status = 500
+        n_urls = 1
         try:
             req = json.loads(body)
             if "urls" in req:  # batch extension; {"url": ...} is the
                 # reference's schema (reference test.py:15) and unchanged
-                preds = self.apply_model_batch(list(req["urls"]))
+                urls = list(req["urls"])
+                n_urls = len(urls)
+                preds = self.apply_model_batch(urls, rid)
+                status = 200
                 return 200, json.dumps({"predictions": preds}).encode(), "application/json"
-            scores = self.apply_model(req["url"])
+            scores = self.apply_model(req["url"], rid)
+            status = 200
             return 200, json.dumps(scores).encode(), "application/json"
         except UpstreamError as e:
             self._m_errors.inc()
+            status = e.http_status
             return e.http_status, json.dumps({"error": str(e)}).encode(), "application/json"
+        except QueueFull as e:
+            # The upstream micro-batcher's transient overload signal: a
+            # retryable 503, exactly like the model tier's own QueueFull --
+            # NOT a 400, which clients would treat as a permanent error.
+            self._m_errors.inc()
+            status = 503
+            return 503, json.dumps({"error": f"overloaded: {e}"}).encode(), "application/json"
         except Exception as e:
             # Bad JSON, missing "url", unfetchable/undecodable image:
             # genuinely the caller's fault.
             self._m_errors.inc()
+            status = 400
             return 400, json.dumps({"error": str(e)}).encode(), "application/json"
         finally:
             self._m_latency.observe(time.perf_counter() - t0)
+            if self.request_log or status >= 500:
+                log_request("gateway predict", rid, status=status, t0=t0, urls=n_urls)
 
     # --- HTTP plumbing ----------------------------------------------------
 
@@ -315,10 +377,12 @@ class Gateway:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, code: int, body: bytes, ctype: str):
+            def _send(self, code: int, body: bytes, ctype: str, rid: str = ""):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if rid:
+                    self.send_header(REQUEST_ID_HEADER, rid)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -326,16 +390,19 @@ class Gateway:
                 self._send(*gw.handle_get(self.path))
 
             def do_POST(self):
+                rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
                 if self.path != "/predict":
-                    return self._send(404, b'{"error": "not found"}', "application/json")
+                    return self._send(
+                        404, b'{"error": "not found"}', "application/json", rid
+                    )
                 length = int(self.headers.get("Content-Length", 0))
                 rejected = gw.reject_oversize(length)
                 if rejected is not None:
                     # The unread body is still in the socket; close rather
                     # than let keep-alive parse gigabytes as a next request.
                     self.close_connection = True
-                    return self._send(*rejected)
-                self._send(*gw.handle_predict(self.rfile.read(length)))
+                    return self._send(*rejected, rid)
+                self._send(*gw.handle_predict(self.rfile.read(length), rid), rid)
 
         return Handler
 
@@ -352,6 +419,8 @@ class Gateway:
             self._thread.start()
 
     def shutdown(self) -> None:
+        if self._microbatcher is not None:
+            self._microbatcher.close()
         if self._httpd is None:
             return
         # See ModelServer.shutdown: BaseServer.shutdown() hangs if
@@ -366,8 +435,28 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
     p.add_argument("--serving-host", default=None, help=f"overrides ${SERVING_HOST_ENV}")
     p.add_argument("--model", default=None, help=f"overrides ${MODEL_ENV}")
+    p.add_argument(
+        "--no-request-log",
+        action="store_true",
+        help="disable the per-request traced log line (rid, status, duration)",
+    )
+    p.add_argument(
+        "--upstream-batch",
+        type=int,
+        default=0,
+        help="coalesce concurrent single-image requests into one upstream "
+        "predict of up to this size (0 = off, one upstream call per request)",
+    )
+    p.add_argument("--upstream-delay-ms", type=float, default=2.0)
     args = p.parse_args(argv)
-    gw = Gateway(serving_host=args.serving_host, model=args.model, port=args.port)
+    gw = Gateway(
+        serving_host=args.serving_host,
+        model=args.model,
+        port=args.port,
+        request_log=not args.no_request_log,
+        upstream_batch=args.upstream_batch,
+        upstream_delay_ms=args.upstream_delay_ms,
+    )
     print(f"gateway listening on :{gw.port}, model tier at {gw.serving_host}")
     gw.start(block=True)
     return 0

@@ -67,11 +67,11 @@ _DTYPES: dict[int, tuple[np.dtype, str]] = {
     10: (np.dtype(np.bool_), "bool_val"),
     19: (np.dtype(np.float16), "half_val"),
 }
-_DTYPE_TO_ENUM = {np.dtype(np.float32): 1, np.dtype(np.float64): 2,
-                  np.dtype(np.int32): 3, np.dtype(np.uint8): 4,
-                  np.dtype(np.int16): 5, np.dtype(np.int8): 6,
-                  np.dtype(np.int64): 9, np.dtype(np.bool_): 10,
-                  np.dtype(np.float16): 19}
+# Derived inverse (first enum wins -- iteration order puts the canonical
+# enum for each numpy dtype first), so the tables cannot drift apart.
+_DTYPE_TO_ENUM: dict[np.dtype, int] = {}
+for _enum, (_dt, _) in _DTYPES.items():
+    _DTYPE_TO_ENUM.setdefault(_dt, _enum)
 
 
 def array_from_tensor_proto(tp: tensor_pb2.TensorProto) -> np.ndarray:
@@ -151,12 +151,25 @@ class PredictionServicer:
         )
 
     def Predict(self, request: predict_pb2.PredictRequest, context):
+        from kubernetes_deep_learning_tpu.serving.tracing import (
+            GRPC_METADATA_KEY,
+            ensure_request_id,
+            log_request,
+        )
+
         t0 = time.perf_counter()
+        raw = dict(context.invocation_metadata()).get(GRPC_METADATA_KEY)
+        rid = ensure_request_id(raw)
+        context.set_trailing_metadata(((GRPC_METADATA_KEY, rid),))
+        status = "INTERNAL"
         self._m_requests.inc()
         try:
-            return self._predict(request)
+            resp = self._predict(request)
+            status = "OK"
+            return resp
         except KeyError as e:
             self._m_errors.inc()
+            status = "NOT_FOUND"
             # TF-Serving's own wording for an unknown servable.
             context.abort(
                 grpc.StatusCode.NOT_FOUND,
@@ -164,9 +177,11 @@ class PredictionServicer:
             )
         except ValueError as e:
             self._m_errors.inc()
+            status = "INVALID_ARGUMENT"
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except (QueueFull, FuturesTimeout) as e:
             self._m_errors.inc()
+            status = "RESOURCE_EXHAUSTED"
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED, f"overloaded: {e or 'timed out'}"
             )
@@ -177,6 +192,14 @@ class PredictionServicer:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         finally:
             self._m_latency.observe(time.perf_counter() - t0)
+            if self._server.request_log or status == "INTERNAL":
+                log_request(
+                    "model-server grpc-predict",
+                    rid,
+                    status=status,
+                    t0=t0,
+                    model=request.model_spec.name,
+                )
 
     def _predict(self, request):
         from kubernetes_deep_learning_tpu.serving.model_server import (

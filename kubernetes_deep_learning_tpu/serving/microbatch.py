@@ -1,0 +1,127 @@
+"""Gateway-side upstream micro-batching: fat requests to the model tier.
+
+Throughput math that motivates this (measured in BENCH.md's host-path
+section): the model server is ONE Python process per accelerator, so its
+HTTP/protocol handling is GIL-serialized -- per-request host cost caps its
+single-image ingest rate regardless of handler threads.  Gateways, by
+contrast, are stateless and scale horizontally (the reference's own replica
+mechanism).  Coalescing concurrent single-image gateway requests into one
+upstream predict moves the per-request overhead to the tier that scales,
+and turns the model tier's workload into few, large requests whose
+per-image host cost is tens of microseconds.
+
+This is the same policy/shape as the model tier's own DynamicBatcher
+(queue + linger + size trigger) applied one tier up; the model tier's
+batcher stays useful for traffic arriving from MANY gateway replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+# An unresponsive upstream must surface as an error, not an eternal hang;
+# matches the model tier's own batcher wait bound (runtime/batcher.py) and
+# comfortably exceeds the gateway's upstream read timeout.
+RESULT_TIMEOUT_S = 120.0
+
+
+class UpstreamMicroBatcher:
+    """Coalesce single-image predicts into one upstream batch call.
+
+    ``predict_batch(images, request_id) -> (logit_rows, labels)`` is the
+    gateway's existing upstream call; requests enqueue (image, future) and a
+    single dispatcher thread flushes on max_batch or linger expiry.
+    Upstream failures propagate to every waiter of the flushed batch.
+    """
+
+    def __init__(
+        self,
+        predict_batch,
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+        max_queue: int = 1024,
+    ):
+        self._predict_batch = predict_batch
+        self.max_batch = max_batch
+        self._max_delay_s = max_delay_ms / 1e3
+        self._max_queue = max_queue
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queue: list[tuple[np.ndarray, str, Future]] = []
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="kdlt-upstream-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def predict(self, image: np.ndarray, request_id: str = ""):
+        """One image (H,W,C) -> (logit_row, labels); blocks until served."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("upstream batcher is closed")
+            if len(self._queue) >= self._max_queue:
+                from kubernetes_deep_learning_tpu.runtime import QueueFull
+
+                raise QueueFull(
+                    f"upstream batch queue at {self._max_queue} entries"
+                )
+            self._queue.append((image, request_id, fut))
+            self._nonempty.notify()
+        return fut.result(timeout=RESULT_TIMEOUT_S)
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._nonempty.wait()
+                if self._closed and not self._queue:
+                    return
+                # Linger: once something is queued, keep waiting until the
+                # batch fills or the deadline passes.  wait() wakes on EVERY
+                # enqueue notify, so the deadline must be re-checked in a
+                # loop (a single wait(delay) would flush ~size-2 batches
+                # under steady load; same pattern as DynamicBatcher).
+                deadline = time.monotonic() + self._max_delay_s
+                while len(self._queue) < self.max_batch and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._nonempty.wait(remaining):
+                        break
+                batch = self._queue[: self.max_batch]
+                del self._queue[: self.max_batch]
+            if not batch:
+                continue
+            images = np.stack([b[0] for b in batch])
+            rid = batch[0][1]  # trace under the first waiter's id; the
+            # upstream log line carries the batch size so the fan-in is
+            # visible from either tier's logs.
+            try:
+                rows, labels = self._predict_batch(images, rid)
+                if len(rows) < len(batch):
+                    raise RuntimeError(
+                        f"upstream returned {len(rows)} rows for "
+                        f"{len(batch)} images"
+                    )
+            except BaseException as e:  # noqa: BLE001 - fan the failure out
+                for _, _, fut in batch:
+                    fut.set_exception(e)
+                continue
+            # Fan-out must also never kill the dispatcher: a failure here
+            # (anything unexpected) resolves the remaining futures with the
+            # error instead of leaving waiters blocked forever.
+            for i, (_, _, fut) in enumerate(batch):
+                try:
+                    fut.set_result((rows[i], labels))
+                except BaseException as e:  # noqa: BLE001
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()
+        self._thread.join(timeout=5)

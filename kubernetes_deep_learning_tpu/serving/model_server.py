@@ -51,6 +51,11 @@ from kubernetes_deep_learning_tpu.runtime import (
     QueueFull,
     create_batcher,
 )
+from kubernetes_deep_learning_tpu.serving.tracing import (
+    REQUEST_ID_HEADER,
+    ensure_request_id,
+    log_request,
+)
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
@@ -63,8 +68,12 @@ MAX_IMAGES_PER_REQUEST = 2048  # bounds one request's decoded-image memory
 class ServedModel:
     def __init__(
         self, artifact, buckets, max_delay_ms, registry, use_batcher=True,
-        batcher_impl="auto", mesh=None, mesh_mode="data",
+        batcher_impl="auto", mesh=None, mesh_mode="data", engine_factory=None,
     ):
+        # engine_factory: swap the execution engine (default InferenceEngine).
+        # runtime.stub.StubEngine measures the host path with the device
+        # taken out (bench.py --host-saturation).
+        engine_factory = engine_factory or InferenceEngine
         self.artifact = artifact
         self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
         # Each model version gets a labeled child registry so two models (or
@@ -75,7 +84,7 @@ class ServedModel:
             model=artifact.spec.name, version=str(self.version)
         )
         try:
-            self.engine = InferenceEngine(
+            self.engine = engine_factory(
                 artifact, buckets=buckets, registry=self.registry_child,
                 mesh=mesh, mesh_mode=mesh_mode,
             )
@@ -145,7 +154,13 @@ class ModelServer:
         mesh=None,
         mesh_mode: str = "data",
         profile_base: str | None = "",
+        request_log: bool = False,
+        engine_factory=None,
     ):
+        # request_log: one traced stdout line per predict (rid, model, batch,
+        # status, duration) -- the model-tier half of the gateway's
+        # X-Request-Id propagation.  Errors are always logged with the rid.
+        self.request_log = request_log
         # profile_base: directory for /debug/profile traces; "" means a
         # default under the system temp dir, None disables the endpoint.
         if profile_base == "":
@@ -171,6 +186,7 @@ class ModelServer:
         self._batcher_impl = batcher_impl
         self._mesh = mesh
         self._mesh_mode = mesh_mode
+        self._engine_factory = engine_factory
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self._profile_lock = threading.Lock()
@@ -245,6 +261,7 @@ class ModelServer:
                     self._batcher_impl,
                     self._mesh,
                     self._mesh_mode,
+                    self._engine_factory,
                 )
                 fresh.engine.warmup()
             except Exception as e:
@@ -294,6 +311,8 @@ class ModelServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if getattr(self, "_rid", ""):
+                    self.send_header(REQUEST_ID_HEADER, self._rid)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -301,6 +320,7 @@ class ModelServer:
                 self._send(code, json.dumps(obj).encode())
 
             def do_GET(self):
+                self._rid = ""  # keep-alive: never echo a previous POST's id
                 if self.path == "/healthz":
                     return self._send(200, b"ok", "text/plain")
                 if self.path == "/readyz":
@@ -330,9 +350,17 @@ class ModelServer:
             def do_POST(self):
                 from kubernetes_deep_learning_tpu.serving import protocol
 
+                self._rid = ""  # keep-alive: never echo a previous request's id
                 if self.path == "/debug/profile":
                     return self._profile()
                 t0 = time.perf_counter()
+                # The traced id from the gateway (or minted here for direct
+                # clients): echoed in the response and stamped on this tier's
+                # log line, completing the cross-tier trace.
+                rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
+                self._rid = rid
+                status = 500
+                batch = 0
                 server._m_requests.inc()
                 m = _PREDICT_RE.match(self.path)
                 if not m:
@@ -380,22 +408,36 @@ class ModelServer:
                             f"batch {images.shape[0]} exceeds the "
                             f"{MAX_IMAGES_PER_REQUEST}-image request limit"
                         )
+                    batch = images.shape[0]
                     logits = model.predict(images)
                     out, out_ctype = protocol.encode_predict_response(
                         logits, spec.labels, ctype
                     )
+                    status = 200
                     self._send(200, out, out_ctype)
                 except ValueError as e:  # malformed request
                     server._m_errors.inc()
+                    status = 400
                     self._send_json(400, {"error": str(e)})
                 except (QueueFull, FuturesTimeout) as e:  # transient overload
                     server._m_errors.inc()
+                    status = 503
                     self._send_json(503, {"error": f"overloaded: {e or 'timed out'}"})
                 except Exception as e:  # internal failure
                     server._m_errors.inc()
+                    status = 500
                     self._send_json(500, {"error": str(e)})
                 finally:
                     server._m_latency.observe(time.perf_counter() - t0)
+                    if server.request_log or status >= 500:
+                        log_request(
+                            "model-server predict",
+                            rid,
+                            status=status,
+                            t0=t0,
+                            model=m.group(1),
+                            batch=batch,
+                        )
 
             def _profile(self):
                 """Capture a jax.profiler trace while live traffic runs.
@@ -534,6 +576,11 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="jax platform override (e.g. cpu for dev); default $KDLT_PLATFORM",
     )
+    p.add_argument(
+        "--no-request-log",
+        action="store_true",
+        help="disable the per-request traced log line (rid, model, batch, status)",
+    )
     args = p.parse_args(argv)
 
     from kubernetes_deep_learning_tpu.utils.platform import force_platform
@@ -576,6 +623,7 @@ def main(argv: list[str] | None = None) -> int:
         mesh=mesh,
         mesh_mode=args.parallel_mode,
         profile_base=None if args.no_profiling else args.profile_dir,
+        request_log=not args.no_request_log,
     )
     server.warmup()
     if args.watch_interval > 0:

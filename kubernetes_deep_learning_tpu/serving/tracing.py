@@ -1,0 +1,45 @@
+"""Cross-tier request tracing: one id from client edge to model server.
+
+The reference has no tracing at all (SURVEY.md section 5: the only latency
+control is a 20 s deadline).  Here every request carries an ``X-Request-Id``:
+the gateway accepts a client-supplied id or mints one, forwards it to the
+model tier on the upstream call (HTTP header / gRPC metadata), and both
+tiers echo it in the response and stamp it on their log lines -- so one
+``kubectl logs`` grep over both pods reconstructs a request's path.
+
+Ids are sanitized to a conservative charset before logging or forwarding:
+a client-chosen id must not be able to inject log lines or header structure.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+
+REQUEST_ID_HEADER = "X-Request-Id"
+GRPC_METADATA_KEY = "x-request-id"  # gRPC metadata keys are lowercase
+
+_RID_SAFE_RE = re.compile(r"[^A-Za-z0-9_.\-]")
+
+
+def ensure_request_id(raw: str | None) -> str:
+    """Sanitized client-supplied id, or a fresh 16-hex-char one."""
+    if raw:
+        rid = _RID_SAFE_RE.sub("", raw)[:64]
+        if rid:
+            return rid
+    return uuid.uuid4().hex[:16]
+
+
+def log_request(
+    tier: str, rid: str, *, status: int | str, t0: float, **fields
+) -> None:
+    """One stdout line per request, kubectl-logs-greppable by rid.
+
+    ``fields`` are extra key=value pairs (model name, batch size, ...).
+    Values are str()'d; callers pass only values they control.
+    """
+    extra = "".join(f" {k}={v}" for k, v in fields.items())
+    dur_ms = (time.perf_counter() - t0) * 1e3
+    print(f"[rid={rid}] {tier} status={status} dur_ms={dur_ms:.1f}{extra}", flush=True)

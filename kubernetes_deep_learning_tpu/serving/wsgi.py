@@ -38,8 +38,14 @@ class GatewayWSGI:
         self.gateway = gateway or Gateway(bind=False)
 
     def __call__(self, environ: dict, start_response: Callable) -> Iterable[bytes]:
+        from kubernetes_deep_learning_tpu.serving.tracing import (
+            REQUEST_ID_HEADER,
+            ensure_request_id,
+        )
+
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
+        rid = ensure_request_id(environ.get("HTTP_X_REQUEST_ID"))
         if method == "GET":
             code, body, ctype = self.gateway.handle_get(path)
         elif method == "POST" and path == "/predict":
@@ -50,13 +56,17 @@ class GatewayWSGI:
                 # discards the connection on its own
             else:
                 code, body, ctype = self.gateway.handle_predict(
-                    environ["wsgi.input"].read(length)
+                    environ["wsgi.input"].read(length), rid
                 )
         else:
             code, body, ctype = 404, b'{"error": "not found"}', "application/json"
         start_response(
             _status_line(code),
-            [("Content-Type", ctype), ("Content-Length", str(len(body)))],
+            [
+                ("Content-Type", ctype),
+                ("Content-Length", str(len(body))),
+                (REQUEST_ID_HEADER, rid),
+            ],
         )
         return [body]
 
