@@ -1,0 +1,58 @@
+"""Time the full forward under the current XLA_FLAGS (one setting per
+process -- XLA reads flags at backend init).  Driven by exp/flag_sweep.sh."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def main() -> None:
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    fwd = build_forward(spec, dtype=jnp.bfloat16)
+
+    @partial(jax.jit, static_argnums=2)
+    def chained(v, x, k):
+        def body(carry, _):
+            acc, xi = carry
+            s = fwd(v, xi).sum()
+            bit = jnp.signbit(s).astype(xi.dtype)
+            return (acc + s.astype(jnp.float32), xi ^ bit), None
+
+        (acc, _), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), x), None, length=k
+        )
+        return acc
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(rng.integers(0, 256, (batch, *spec.input_shape), np.uint8), dev)
+    k = 8
+    float(chained(variables, x, k))
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(chained(variables, x, k))
+        times.append((time.perf_counter() - t0) / k)
+    t = float(np.median(times))
+    print(
+        f"RESULT {t * 1e3:8.3f} ms  {batch / t:8.0f} img/s   "
+        f"XLA_FLAGS={os.environ.get('XLA_FLAGS', '')!r}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,327 @@
+"""Pallas prototype: fused Xception entry segment (conv2 + block2).
+
+Covers the trace's top entry-flow fusions (~17.5 ms of the batch-256
+forward): block1_conv2 3x3 VALID (32->64) + BN/relu, block2's residual
+1x1/2 conv + BN, sepconv1 (64->128) + BN + relu, sepconv2 (128) + BN,
+maxpool 3x3/2 + residual add.  Intermediates at 147x147 never touch HBM.
+
+Layout (rows, W, bt, C): batch on sublanes, channels on lanes (same trick
+as the middle-flow kernel); spatial tiled over OUTPUT rows with halo rows
+on the input.  conv2 runs as in-kernel im2col (9 lane-concatenated shifted
+slices -> one (M, 288) @ (288, 64) GEMM); depthwise convs are shifted FMAs
+on outer dims; pool/residual use stride-2 outer-dim slices.
+
+Validates against the plain-jnp reference, then times vs the XLA graph.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import numpy as np
+
+H_IN = 149   # conv1 output spatial (input to this kernel)
+H_B = 147    # after conv2 VALID
+H_OUT = 74   # after pool stride 2 SAME
+C_IN, C_B, C_OUT = 32, 64, 128
+
+
+def make_weights(rng):
+    import jax.numpy as jnp
+
+    w = {
+        "conv2": rng.normal(0, 0.1, (3, 3, C_IN, C_B)).astype(np.float32),
+        "conv2_s": rng.uniform(0.8, 1.2, C_B).astype(np.float32),
+        "conv2_b": rng.normal(0, 0.1, C_B).astype(np.float32),
+        "res": rng.normal(0, 0.1, (C_B, C_OUT)).astype(np.float32),
+        "res_s": rng.uniform(0.8, 1.2, C_OUT).astype(np.float32),
+        "res_b": rng.normal(0, 0.1, C_OUT).astype(np.float32),
+        "dw1": rng.normal(0, 0.2, (3, 3, C_B)).astype(np.float32),
+        "pw1": rng.normal(0, 0.05, (C_B, C_OUT)).astype(np.float32),
+        "bn1_s": rng.uniform(0.8, 1.2, C_OUT).astype(np.float32),
+        "bn1_b": rng.normal(0, 0.1, C_OUT).astype(np.float32),
+        "dw2": rng.normal(0, 0.2, (3, 3, C_OUT)).astype(np.float32),
+        "pw2": rng.normal(0, 0.05, (C_OUT, C_OUT)).astype(np.float32),
+        "bn2_s": rng.uniform(0.8, 1.2, C_OUT).astype(np.float32),
+        "bn2_b": rng.normal(0, 0.1, C_OUT).astype(np.float32),
+    }
+    return {k: jnp.asarray(v) for k, v in w.items()}
+
+
+def entry_ref(a, w):
+    """Plain-jnp reference, NHWC (B, 149, 149, 32) bf16 -> (B, 74, 74, 128)."""
+    import jax
+    import jax.numpy as jnp
+
+    def conv(x, k, stride=1, padding="VALID", fgc=1):
+        return jax.lax.conv_general_dilated(
+            x, k.astype(x.dtype), (stride, stride), padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=fgc,
+        )
+
+    b = conv(a, w["conv2"])  # (B,147,147,64)
+    b = jnp.maximum(
+        (b.astype(jnp.float32) * w["conv2_s"] + w["conv2_b"]), 0
+    ).astype(jnp.bfloat16)
+    r = jnp.einsum("bhwc,cd->bhwd", b[:, ::2, ::2, :], w["res"].astype(jnp.bfloat16))
+    r = (r.astype(jnp.float32) * w["res_s"] + w["res_b"]).astype(jnp.bfloat16)
+    c = conv(b, w["dw1"][:, :, None, :].astype(jnp.bfloat16), padding="SAME", fgc=C_B)
+    c = jnp.einsum("bhwc,cd->bhwd", c, w["pw1"].astype(jnp.bfloat16))
+    c = jnp.maximum(
+        c.astype(jnp.float32) * w["bn1_s"] + w["bn1_b"], 0
+    ).astype(jnp.bfloat16)
+    d = conv(c, w["dw2"][:, :, None, :].astype(jnp.bfloat16), padding="SAME", fgc=C_OUT)
+    d = jnp.einsum("bhwc,cd->bhwd", d, w["pw2"].astype(jnp.bfloat16))
+    d = (d.astype(jnp.float32) * w["bn2_s"] + w["bn2_b"]).astype(jnp.bfloat16)
+    pooled = jax.lax.reduce_window(
+        d, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    return pooled + r
+
+
+def fused_entry(a_t, w, *, bt=8, rt=10, interpret=False):
+    """Kernel on (149, 149, B, 32) bf16 -> (74, 74, B, 128).
+
+    Grid: (ceil(74/rt), B // bt).  Each instance computes ``rt`` output rows
+    for ``bt`` images.  Overlapping input row windows are not expressible in
+    BlockSpec units, so the input is pre-gathered into per-tile slabs
+    (n_tiles, ht_a, Wp, B, 32) in XLA-land -- ~25% extra input traffic, the
+    simple-first trade (manual HBM DMA with dynamic offsets would avoid it).
+
+    Geometry (all offsets static): tile g covers output rows
+    [rt*g, rt*g+rt).  The SAME max-pool for 147 -> 74 pads (1,1), so out
+    row i's window is d rows 2i-1 .. 2i+1; through the two SAME dws (+-1
+    each) the tile needs b rows [2*rt*g - 3, 2*rt*g + 2*rt + 2) => ht_b =
+    2*rt + 5 with row0_b = 2*rt*g - 3, and a rows [row0_b, row0_b + ht_a),
+    ht_a = ht_b + 2 (conv2 VALID).  The padded-a slab makes every slice
+    in-range; a validity mask re-zeroes rows the BN affines contaminate.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, _, B, _ = a_t.shape
+    bt = min(bt, B)
+    n_tiles = -(-H_OUT // rt)
+    ht_b = 2 * rt + 5
+    ht_a = ht_b + 2
+    # Pad: a row for global b row -2 is global a row -2 -> top pad 2; right
+    # W pad 2 for conv2's VALID reach (149 cols -> col index up to 148+2).
+    # Slab g reads PADDED rows [2*rt*g, +ht_a); the padded array has
+    # 3 + H_IN + bottom rows and must cover the last slab (top pad 3:
+    # slab g starts at global a row 2*rt*g - 3).
+    bottom = max(0, 2 * rt * (n_tiles - 1) + ht_a - (H_IN + 3))
+    a_pad = jnp.pad(a_t, ((3, bottom), (0, 2), (0, 0), (0, 0)))
+    Wp = H_IN + 2  # 151
+    # Pre-gathered overlapping slabs: slab g = padded rows [2*rt*g, +ht_a).
+    slabs = jnp.stack(
+        [a_pad[2 * rt * g : 2 * rt * g + ht_a] for g in range(n_tiles)]
+    )  # (n_tiles, ht_a, Wp, B, C_IN)
+
+    def kernel(a_ref, cv_ref, cvs_ref, cvb_ref, res_ref, ress_ref, resb_ref,
+               dw1_ref, pw1_ref, s1_ref, b1_ref, dw2_ref, pw2_ref, s2_ref,
+               b2_ref, o_ref):
+        g_r = pl.program_id(0)
+        a = a_ref[0]  # (ht_a, Wp, bt, 32)
+
+        # --- conv2 3x3 VALID: 9 shifted GEMMs (K=32), accumulated ----------
+        z = None
+        for dh in range(3):
+            for dwc in range(3):
+                sl = a[dh : dh + ht_b, dwc : dwc + H_B, :, :]
+                t = jax.lax.dot_general(
+                    sl.reshape(ht_b * H_B * bt, C_IN),
+                    cv_ref[dh, dwc].astype(jnp.bfloat16),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                z = t if z is None else z + t
+        b = jnp.maximum(z * cvs_ref[...] + cvb_ref[...], 0).astype(
+            jnp.bfloat16
+        ).reshape(ht_b, H_B, bt, C_B)
+
+        # Validity of local b rows: global b row = 2*rt*g - 3 + L.
+        row0_b = 2 * rt * g_r - 3
+        rows = jax.lax.broadcasted_iota(jnp.int32, (ht_b, 1, 1, 1), 0) + row0_b
+        valid_b = ((rows >= 0) & (rows < H_B)).astype(jnp.bfloat16)
+        b = b * valid_b
+
+        # --- residual: 1x1 stride-2 on b (row0_b odd: local 3,5,... are the
+        # global even rows 2*rt*g, 2*rt*g + 2, ...) ------------------------
+        b_even = b[3::2, ::2, :, :]
+        hr, wr = b_even.shape[0], b_even.shape[1]
+        r = jax.lax.dot_general(
+            b_even.reshape(hr * wr * bt, C_B),
+            res_ref[...].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        r = (r * ress_ref[...] + resb_ref[...]).astype(jnp.bfloat16).reshape(
+            hr, wr, bt, C_OUT
+        )
+
+        # --- sepconvs ------------------------------------------------------
+        def dw(x, dwk):
+            xp = jnp.pad(x, ((1, 1), (1, 1), (0, 0), (0, 0)))
+            acc = jnp.zeros(x.shape, jnp.float32)
+            for dh in range(3):
+                for dwc in range(3):
+                    acc = acc + (
+                        xp[dh : dh + x.shape[0], dwc : dwc + x.shape[1], :, :]
+                        .astype(jnp.float32) * dwk[dh, dwc, :]
+                    )
+            return acc
+
+        c = dw(b, dw1_ref[...])
+        c = jax.lax.dot_general(
+            c.astype(jnp.bfloat16).reshape(ht_b * H_B * bt, C_B),
+            pw1_ref[...].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        c = jnp.maximum(c * s1_ref[...] + b1_ref[...], 0).astype(
+            jnp.bfloat16
+        ).reshape(ht_b, H_B, bt, C_OUT)
+        c = c * valid_b  # re-zero rows the BN bias contaminated
+
+        d = dw(c, dw2_ref[...])
+        d = jax.lax.dot_general(
+            d.astype(jnp.bfloat16).reshape(ht_b * H_B * bt, C_OUT),
+            pw2_ref[...].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        d = (d * s2_ref[...] + b2_ref[...]).reshape(ht_b, H_B, bt, C_OUT)
+        # Invalid rows must lose the max-pool, not win it.
+        d = jnp.where(valid_b > 0, d, -1e9).astype(jnp.bfloat16)
+        # SAME pool (1,1) col padding: out col c's window is cols 2c-1..2c+1.
+        d = jnp.pad(d, ((0, 0), (1, 1), (0, 0), (0, 0)), constant_values=-1e9)
+
+        # --- maxpool 3x3/2 + residual --------------------------------------
+        # Out row j of this tile: window d rows 2*(rt*g+j)-1 .. +1, local
+        # (with row0_b = 2*rt*g - 3) = 2j+2 .. 2j+4; padded cols give
+        # window col index 2c + dwc.
+        pooled = None
+        for dh in range(3):
+            for dwc in range(3):
+                sl = d[2 + dh :: 2, dwc :: 2, :, :][:rt, :H_OUT, :, :]
+                pooled = sl if pooled is None else jnp.maximum(pooled, sl)
+        o_ref[0] = pooled + r[:rt, :H_OUT, :, :]
+
+    grid = (n_tiles, B // bt)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, ht_a, Wp, bt, C_IN), lambda gr, gb: (gr, 0, 0, gb, 0)),
+            pl.BlockSpec((3, 3, C_IN, C_B), lambda gr, gb: (0, 0, 0, 0)),
+            pl.BlockSpec((C_B,), lambda gr, gb: (0,)),
+            pl.BlockSpec((C_B,), lambda gr, gb: (0,)),
+            pl.BlockSpec((C_B, C_OUT), lambda gr, gb: (0, 0)),
+            pl.BlockSpec((C_OUT,), lambda gr, gb: (0,)),
+            pl.BlockSpec((C_OUT,), lambda gr, gb: (0,)),
+            pl.BlockSpec((3, 3, C_B), lambda gr, gb: (0, 0, 0)),
+            pl.BlockSpec((C_B, C_OUT), lambda gr, gb: (0, 0)),
+            pl.BlockSpec((C_OUT,), lambda gr, gb: (0,)),
+            pl.BlockSpec((C_OUT,), lambda gr, gb: (0,)),
+            pl.BlockSpec((3, 3, C_OUT), lambda gr, gb: (0, 0, 0)),
+            pl.BlockSpec((C_OUT, C_OUT), lambda gr, gb: (0, 0)),
+            pl.BlockSpec((C_OUT,), lambda gr, gb: (0,)),
+            pl.BlockSpec((C_OUT,), lambda gr, gb: (0,)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, rt, H_OUT, bt, C_OUT), lambda gr, gb: (gr, 0, 0, gb, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_tiles, rt, H_OUT, B, C_OUT), jnp.bfloat16
+        ),
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=interpret,
+    )(
+        slabs, w["conv2"], w["conv2_s"], w["conv2_b"], w["res"], w["res_s"],
+        w["res_b"], w["dw1"], w["pw1"], w["bn1_s"], w["bn1_b"], w["dw2"],
+        w["pw2"], w["bn2_s"], w["bn2_b"],
+    )
+    # (n_tiles, rt, 74, B, 128) -> (74(+crop), 74, B, 128)
+    return out.reshape(n_tiles * rt, H_OUT, B, C_OUT)[:H_OUT]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--bt", type=int, default=8)
+    p.add_argument("--rt", type=int, default=10)
+    p.add_argument("--scan-len", type=int, default=8)
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--interpret", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}, batch {args.batch}, bt {args.bt}, rt {args.rt}")
+    rng = np.random.default_rng(0)
+    w = make_weights(rng)
+
+    a_small = jnp.asarray(rng.normal(0, 0.5, (8, H_IN, H_IN, C_IN)), jnp.bfloat16)
+    want = np.asarray(entry_ref(a_small, w), np.float32)
+    got = np.asarray(
+        jax.jit(
+            functools.partial(fused_entry, bt=8, rt=args.rt, interpret=args.interpret)
+        )(a_small.transpose(1, 2, 0, 3), w).transpose(2, 0, 1, 3),
+        np.float32,
+    )
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+    print(f"fused entry vs ref: max rel err {rel:.2e}")
+    assert rel < 3e-2, "diverges"
+    if args.interpret:
+        print("interpret-mode PASS")
+        return
+
+    a = jax.device_put(
+        jnp.asarray(rng.normal(0, 0.5, (args.batch, H_IN, H_IN, C_IN)), jnp.bfloat16),
+        dev,
+    )
+
+    for name, fn in (
+        ("asis", lambda x, w: entry_ref(x, w)),
+        (
+            "fused",
+            lambda x, w: fused_entry(
+                x.transpose(1, 2, 0, 3), w, bt=args.bt, rt=args.rt
+            ).transpose(2, 0, 1, 3),
+        ),
+    ):
+        @functools.partial(jax.jit, static_argnums=2)
+        def chained(xx, ww, k, fn=fn):
+            def body(carry, _):
+                acc, xi = carry
+                out = fn(xi, ww)
+                s = out.sum()
+                xi = xi + (jnp.sign(s) * 1e-3).astype(xi.dtype)
+                return (acc + s.astype(jnp.float32), xi), None
+
+            (acc, _), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), xx), None, length=k
+            )
+            return acc
+
+        try:
+            float(chained(a, w, args.scan_len))
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                float(chained(a, w, args.scan_len))
+                times.append((time.perf_counter() - t0) / args.scan_len)
+            print(f"{name:6s}: {float(np.median(times)) * 1e3:8.3f} ms")
+        except Exception as e:
+            print(f"{name:6s}: FAILED {str(e).splitlines()[0][:140]}")
+
+
+if __name__ == "__main__":
+    main()

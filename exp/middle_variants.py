@@ -1,0 +1,140 @@
+"""Where does the Xception middle flow lose 2/3 of peak, and what fixes it?
+
+One middle block = 3x [relu -> depthwise 3x3 (728ch) -> pointwise 728x728 ->
+BN] + residual, at 19x19 spatial.  Variants timed at serving batch:
+
+- asis:      conv_general_dilated with feature_group_count (what flax emits)
+- dw_shift:  depthwise as 9 shifted multiply-adds (VPU-friendly, no conv op)
+- pw_only:   depthwise deleted (lower bound = pure GEMM + elementwise)
+- dw_only /  the isolated depthwise cost both ways
+  dws_only
+
+All share weights; numerics cross-checked (asis vs dw_shift must agree).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+
+import numpy as np
+
+C = 728
+H = W = 19
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--scan-len", type=int, default=16)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}, batch {args.batch}, tensor ({args.batch},{H},{W},{C})")
+    rng = np.random.default_rng(0)
+
+    dw = [rng.normal(0, 0.2, (3, 3, C)).astype(np.float32) for _ in range(3)]
+    pw = [rng.normal(0, 0.03, (C, C)).astype(np.float32) for _ in range(3)]
+    scale = [rng.uniform(0.8, 1.2, C).astype(np.float32) for _ in range(3)]
+    shift = [rng.normal(0, 0.1, C).astype(np.float32) for _ in range(3)]
+    Wt = {
+        "dw": [jnp.asarray(k, jnp.bfloat16) for k in dw],
+        "pw": [jnp.asarray(k, jnp.bfloat16) for k in pw],
+        "s": [jnp.asarray(s) for s in scale],
+        "b": [jnp.asarray(b) for b in shift],
+    }
+
+    def dw_conv(x, k):  # k (3,3,C); what flax SeparableConv2D emits
+        return jax.lax.conv_general_dilated(
+            x, k[:, :, None, :].astype(x.dtype), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=C,
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+
+    def dw_shifted(x, k):  # 9 shifted multiply-adds, SAME padding
+        xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+        acc = jnp.zeros(x.shape, jnp.float32)
+        for i in range(3):
+            for j in range(3):
+                acc = acc + (
+                    xp[:, i : i + H, j : j + W, :].astype(jnp.float32)
+                    * k[i, j].astype(jnp.float32)
+                )
+        return acc.astype(x.dtype)
+
+    def pw_mm(x, k):
+        return jax.lax.dot_general(
+            x, k.astype(x.dtype),
+            (((3,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+
+    def block(x, w, dw_fn, skip_dw=False):
+        y = x
+        for i in range(3):
+            y = jnp.maximum(y, 0)
+            if not skip_dw:
+                y = dw_fn(y, w["dw"][i])
+            y = pw_mm(y, w["pw"][i])
+            y = (y.astype(jnp.float32) * w["s"][i] + w["b"][i]).astype(x.dtype)
+        return x + y
+
+    variants = {
+        "asis": lambda x, w: block(x, w, dw_conv),
+        "dw_shift": lambda x, w: block(x, w, dw_shifted),
+        "pw_only": lambda x, w: block(x, w, None, skip_dw=True),
+        "dw_only": lambda x, w: dw_conv(dw_conv(dw_conv(x, w["dw"][0]), w["dw"][1]), w["dw"][2]),
+        "dws_only": lambda x, w: dw_shifted(dw_shifted(dw_shifted(x, w["dw"][0]), w["dw"][1]), w["dw"][2]),
+    }
+
+    x_small = jnp.asarray(
+        rng.normal(0, 1, (2, H, W, C)), jnp.bfloat16
+    )
+    a = np.asarray(jax.jit(variants["asis"])(x_small, Wt), np.float32)
+    b = np.asarray(jax.jit(variants["dw_shift"])(x_small, Wt), np.float32)
+    rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-6)
+    print(f"dw_shift vs asis max rel err: {rel:.2e}")
+
+    x = jax.device_put(
+        jnp.asarray(rng.normal(0, 1, (args.batch, H, W, C)), jnp.bfloat16), dev
+    )
+
+    # GEMM FLOPs for MFU context: 3 pw per block
+    gemm_tf = 3 * args.batch * H * W * C * C * 2 / 1e12
+
+    for name, fn in variants.items():
+        @partial(jax.jit, static_argnums=2)
+        def chained(xx, w, k, fn=fn):
+            def body(carry, _):
+                acc, xi = carry
+                out = fn(xi, w)
+                s = out.sum()
+                # data-dependence: nudge the input by a sign-dependent ulp
+                xi = xi + (jnp.sign(s) * 1e-3).astype(xi.dtype)
+                return (acc + s.astype(jnp.float32), xi), None
+
+            (acc, _), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), xx), None, length=k
+            )
+            return acc
+
+        float(chained(x, Wt, args.scan_len))
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            float(chained(x, Wt, args.scan_len))
+            times.append((time.perf_counter() - t0) / args.scan_len)
+        t = float(np.median(times))
+        mfu = gemm_tf / t / 197.0 * 100 if "only" not in name or name == "pw_only" else 0
+        extra = f"  (GEMM-only MFU {mfu:4.1f}%)" if mfu else ""
+        print(f"{name:9s}: {t * 1e3:8.3f} ms{extra}")
+
+
+if __name__ == "__main__":
+    main()

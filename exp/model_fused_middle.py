@@ -1,0 +1,202 @@
+"""Full Xception forward with the middle flow replaced by the fused v3
+Pallas chain -- the honest comparison (the standalone block harness inflates
+XLA's cost ~3x vs its in-model fusions).
+
+Extracts the 8 middle blocks' weights from the real flax variables (BN
+folded to scale/shift as the kernel expects), transposes NHWC -> (H,W,B,C)
+once at middle-flow entry, runs 8 chained pallas blocks, transposes back,
+and continues with the stock exit flow.  Checks logits vs build_forward.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+
+import numpy as np
+
+BN_EPS = 1e-5  # flax.linen.BatchNorm default
+
+
+def middle_weights_from_variables(variables):
+    """(dw, pw, scale, shift) stacked per middle block, BN folded."""
+    import jax.numpy as jnp
+
+    params = variables["params"]
+    stats = variables["batch_stats"]
+    blocks = []
+    for idx in range(5, 13):
+        dws, pws, ss, bs = [], [], [], []
+        for j in (1, 2, 3):
+            sep = params[f"block{idx}_sepconv{j}"]
+            bn_p = params[f"block{idx}_sepconv{j}_bn"]
+            bn_s = stats[f"block{idx}_sepconv{j}_bn"]
+            dw = np.asarray(sep["depthwise"]["kernel"])  # (3,3,1,C)
+            pw = np.asarray(sep["pointwise"]["kernel"])  # (1,1,C,C)
+            gamma, beta = np.asarray(bn_p["scale"]), np.asarray(bn_p["bias"])
+            mean, var = np.asarray(bn_s["mean"]), np.asarray(bn_s["var"])
+            s = gamma / np.sqrt(var + BN_EPS)
+            dws.append(dw[:, :, 0, :])
+            pws.append(pw[0, 0])
+            ss.append(s)
+            bs.append(beta - mean * s)
+        blocks.append(
+            (
+                jnp.asarray(np.stack(dws), jnp.float32),
+                jnp.asarray(np.stack(pws), jnp.bfloat16),
+                jnp.asarray(np.stack(ss), jnp.float32),
+                jnp.asarray(np.stack(bs), jnp.float32),
+            )
+        )
+    return blocks
+
+
+def build_fused_forward(spec, variables, bt=8):
+    """forward(images uint8) -> logits, middle flow via pallas v3."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from exp.fused_middle import fused_block_v3
+    from kubernetes_deep_learning_tpu.models.layers import (
+        ClassifierHead,
+        SeparableConv2D,
+        batch_norm,
+    )
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    mw = middle_weights_from_variables(variables)
+    dtype = jnp.bfloat16
+
+    class XceptionFusedMiddle(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            conv = partial(nn.Conv, use_bias=False, dtype=dtype)
+            bn = partial(batch_norm, False, dtype)
+            sep = partial(SeparableConv2D, dtype=dtype)
+            pool = partial(
+                nn.max_pool, window_shape=(3, 3), strides=(2, 2), padding="SAME"
+            )
+            x = conv(32, (3, 3), strides=2, padding="VALID", name="block1_conv1")(x)
+            x = nn.relu(bn("block1_conv1_bn")(x))
+            x = conv(64, (3, 3), padding="VALID", name="block1_conv2")(x)
+            x = nn.relu(bn("block1_conv2_bn")(x))
+            for idx, feat in ((2, 128), (3, 256), (4, 728)):
+                residual = conv(feat, (1, 1), strides=2, padding="SAME", name=f"block{idx}_res_conv")(x)
+                residual = bn(f"block{idx}_res_bn")(residual)
+                if idx > 2:
+                    x = nn.relu(x)
+                x = sep(feat, name=f"block{idx}_sepconv1")(x)
+                x = bn(f"block{idx}_sepconv1_bn")(x)
+                x = nn.relu(x)
+                x = sep(feat, name=f"block{idx}_sepconv2")(x)
+                x = bn(f"block{idx}_sepconv2_bn")(x)
+                x = pool(x) + residual
+            # --- fused middle flow ---
+            xt = x.transpose(1, 2, 0, 3)  # (H, W, B, C)
+            for dw, pw, s, b in mw:
+                xt = fused_block_v3(xt, dw, pw, s, b, bt=bt)
+            x = xt.transpose(2, 0, 1, 3)
+            # --- exit flow (stock) ---
+            residual = conv(1024, (1, 1), strides=2, padding="SAME", name="block13_res_conv")(x)
+            residual = bn("block13_res_bn")(residual)
+            x = nn.relu(x)
+            x = sep(728, name="block13_sepconv1")(x)
+            x = bn("block13_sepconv1_bn")(x)
+            x = nn.relu(x)
+            x = sep(1024, name="block13_sepconv2")(x)
+            x = bn("block13_sepconv2_bn")(x)
+            x = pool(x) + residual
+            x = sep(1536, name="block14_sepconv1")(x)
+            x = nn.relu(bn("block14_sepconv1_bn")(x))
+            x = sep(2048, name="block14_sepconv2")(x)
+            x = nn.relu(bn("block14_sepconv2_bn")(x))
+            return ClassifierHead(
+                spec.num_classes, hidden=spec.head_hidden, dtype=dtype, name="head"
+            )(x)
+
+    mod = XceptionFusedMiddle()
+
+    def forward(v, images):
+        x = normalize(images, spec.preprocessing)
+        return mod.apply(v, x).astype(jnp.float32)
+
+    return forward
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--bt", type=int, default=8)
+    p.add_argument("--scan-len", type=int, default=8)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+
+    import sys
+
+    sys.path.insert(0, ".")
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    variables = init_variables(spec, seed=0)
+    # Jitter BN stats so folding is non-trivial in the numeric check.
+    rng = np.random.default_rng(1)
+
+    def jitter(tree):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                jitter(v)
+            elif k == "mean":
+                tree[k] = rng.normal(0, 0.05, v.shape).astype(np.float32)
+            elif k == "var":
+                tree[k] = rng.uniform(0.5, 1.5, v.shape).astype(np.float32)
+
+    variables = jax.tree_util.tree_map(np.asarray, variables)
+    jitter(variables["batch_stats"])
+
+    fwd_ref = jax.jit(build_forward(spec, dtype=jnp.bfloat16))
+    fwd_fused = jax.jit(build_fused_forward(spec, variables, bt=args.bt))
+
+    x_small = rng.integers(0, 256, (8, *spec.input_shape), np.uint8)
+    a = np.asarray(fwd_ref(variables, x_small))
+    b = np.asarray(fwd_fused(variables, x_small))
+    rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-6)
+    print(f"fused-middle model vs ref: max rel logit err {rel:.2e}")
+    assert rel < 5e-2, "diverges"
+
+    variables = jax.device_put(variables, dev)
+    x = jax.device_put(
+        rng.integers(0, 256, (args.batch, *spec.input_shape), np.uint8), dev
+    )
+    for name, fwd in (("stock", fwd_ref), ("fused_middle", fwd_fused)):
+        @partial(jax.jit, static_argnums=2)
+        def chained(v, xx, k, fwd=fwd):
+            def body(carry, _):
+                acc, xi = carry
+                s = fwd(v, xi).sum()
+                bit = jnp.signbit(s).astype(xi.dtype)
+                return (acc + s.astype(jnp.float32), xi ^ bit), None
+
+            (acc, _), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), xx), None, length=k
+            )
+            return acc
+
+        float(chained(variables, x, args.scan_len))
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            float(chained(variables, x, args.scan_len))
+            times.append((time.perf_counter() - t0) / args.scan_len)
+        t = float(np.median(times))
+        print(f"{name:13s}: {t * 1e3:8.3f} ms  {args.batch / t:8.0f} img/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Real per-op attribution: jax.profiler trace of the Xception forward.
+
+Runs the plain jitted forward (batch N) a few times under
+jax.profiler.trace, then parses the generated .trace.json.gz and aggregates
+device-stream op durations by name prefix -- ground truth for where the
+80 ms actually goes (the prefix-delta method double-counts reductions).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import tempfile
+from collections import defaultdict
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--top", type=int, default=25)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    fwd = jax.jit(build_forward(spec, dtype=jnp.bfloat16))
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.integers(0, 256, (args.batch, *spec.input_shape), np.uint8), dev
+    )
+    jax.block_until_ready(fwd(variables, x))  # compile
+
+    trace_dir = tempfile.mkdtemp(prefix="kdlt-prof-")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(args.iters):
+            jax.block_until_ready(fwd(variables, x))
+
+    files = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    assert files, f"no trace files under {trace_dir}"
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+
+    # Device-stream complete events: pid whose process_name mentions TPU/XLA
+    # ops.  Aggregate wall duration by sanitized op name.
+    pids = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pids[ev["pid"]] = ev["args"].get("name", "")
+    device_pids = {
+        pid for pid, name in pids.items() if name.startswith("/device:TPU")
+    }
+    agg = defaultdict(float)
+    count = defaultdict(int)
+    details = {}
+    total = 0.0
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+            continue
+        name = ev.get("name", "?")
+        if name.startswith("jit_"):  # parent span, double-counts children
+            continue
+        dur = ev.get("dur", 0) / 1e3 / args.iters  # us -> ms, per iter
+        agg[name] += dur
+        count[name] += 1
+        a = ev.get("args") or {}
+        details[name] = a.get("long_name") or a.get("hlo_op") or a.get(
+            "tf_op"
+        ) or ""
+        total += dur
+    print(f"total device op time/iter: {total:.2f} ms  (batch {args.batch})")
+    for key, ms in sorted(agg.items(), key=lambda kv: -kv[1])[: args.top]:
+        d = details[key][:110]
+        print(f"{ms:9.3f} ms  x{count[key] // args.iters:3d}  {key:28s} {d}")
+
+
+if __name__ == "__main__":
+    main()

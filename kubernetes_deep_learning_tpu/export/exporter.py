@@ -42,7 +42,10 @@ def trace_forward(
     """
     from jax import export as jax_export
 
-    forward = build_forward(spec, dtype=dtype)
+    # fast=False: exported StableHLO must lower on every target platform;
+    # the Pallas fast path is a live-jit serving optimization, not a
+    # portable artifact format.
+    forward = build_forward(spec, dtype=dtype, fast=False)
     (b,) = jax_export.symbolic_shape("b")
     img_spec = jax.ShapeDtypeStruct((b, *spec.input_shape), jnp.uint8)
     var_specs = jax.tree.map(

@@ -92,7 +92,11 @@ def main(argv: list[str] | None = None) -> int:
         artifact = art.ModelArtifact(
             spec, variables, None, {"compute_dtype": "float32"}, path="<in-memory>/1"
         )
-        engine = InferenceEngine(artifact, buckets=(1,), use_exported=False)
+        # fast=False: golden parity must check the exact flax graph, never
+        # the approximate fused fast path (models.xception_fast).
+        engine = InferenceEngine(
+            artifact, buckets=(1,), use_exported=False, fast=False
+        )
         scores = engine.predict_scores(image[None])[0]
 
     print("scores:", {k: round(v, 3) for k, v in sorted(scores.items())})

@@ -62,8 +62,13 @@ def init_variables(spec: ModelSpec, seed: int = 0, dtype: Any = None):
     return model.init(jax.random.PRNGKey(seed), dummy)
 
 
+def has_fast_forward(spec: ModelSpec) -> bool:
+    """Whether a fused-Pallas fast path exists for this family."""
+    return spec.family == "xception"
+
+
 def build_forward(
-    spec: ModelSpec, dtype: Any = jnp.bfloat16
+    spec: ModelSpec, dtype: Any = jnp.bfloat16, fast: bool | str = "auto"
 ) -> Callable[[Any, Any], Any]:
     """Return ``f(variables, images) -> logits`` ready for jit/export.
 
@@ -71,15 +76,37 @@ def build_forward(
     ships uint8; see serving.protocol) or pre-normalized float32.  The uint8
     path normalizes on device so the scale/shift fuses into the first conv.
     Logits are returned as float32 regardless of compute dtype.
+
+    ``fast``: "auto" uses the fused-Pallas fast path (models.xception_fast)
+    when the family has one and the default backend is TPU -- same variable
+    tree, bf16-noise-level logit difference, ~20% faster (BENCH.md).  True
+    forces it (tests use interpret mode via the module directly); False
+    keeps the flax graph (exact parity; the exporter uses this so artifacts
+    stay portable across platforms).
     """
-    model = create_model(spec, dtype=dtype)
+    import jax
+
+    if fast == "auto":
+        fast = (
+            has_fast_forward(spec)
+            and jnp.dtype(dtype) == jnp.bfloat16
+            and jax.default_backend() == "tpu"
+        )
+    if fast and has_fast_forward(spec):
+        from kubernetes_deep_learning_tpu.models.xception_fast import (
+            build_fast_forward,
+        )
+
+        inner = build_fast_forward(spec, dtype=dtype)
+    else:
+        model = create_model(spec, dtype=dtype)
+        inner = lambda variables, x: model.apply(variables, x, train=False)  # noqa: E731
 
     def forward(variables, images):
         if images.dtype == jnp.uint8:
             x = normalize(images, spec.preprocessing)
         else:
             x = images.astype(jnp.float32)
-        logits = model.apply(variables, x, train=False)
-        return logits.astype(jnp.float32)
+        return inner(variables, x).astype(jnp.float32)
 
     return forward

@@ -1,0 +1,143 @@
+"""TPU fast path for Xception: the flax graph with the middle flow swapped
+for the fused Pallas sepconv kernel (ops.fused_sepconv).
+
+A pure function over the SAME variable tree the flax module owns -- the
+module stays the single source of structure (init, .h5 import, export,
+training all unchanged); this path only changes how serving COMPUTES the
+forward.  Measured on a v5e chip at batch 256: 83 -> 69 ms per forward
+(+20% throughput, BENCH.md).  Entry/exit flows mirror flax.linen numerics
+op for op (bf16 compute, Keras BN epsilon); the middle flow runs the fused
+kernel in the (H, W, B, C) layout, paying one transpose in and one out.
+
+Numerics: the fused middle folds BN to an f32 affine, so logits differ from
+the flax path by bf16-rounding-level noise (asserted < 1% relative in
+tests/test_fused_sepconv.py); exact-parity paths (golden verification,
+export) keep using the flax graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubernetes_deep_learning_tpu.models.layers import KERAS_BN_EPS
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+from kubernetes_deep_learning_tpu.ops.fused_sepconv import (
+    fused_sepconv_block_t,
+    middle_block_weights,
+)
+
+_ENTRY_BLOCKS = ((2, 128), (3, 256), (4, 728))  # keep in sync with models.xception
+_MIDDLE_BLOCKS = tuple(range(5, 13))
+
+
+def build_fast_forward(
+    spec: ModelSpec, dtype: Any = jnp.bfloat16, interpret: bool = False
+) -> Callable:
+    """Return ``f(variables, normalized_f32_images) -> logits (dtype)``.
+
+    The caller (models.build_forward) handles uint8 normalization and the
+    final f32 cast, exactly as for the flax path.
+    """
+
+    def conv(x, kernel, stride=1, padding="SAME"):
+        # flax nn.Conv(dtype=...) semantics: operands promoted to dtype,
+        # no preferred accumulation type override.
+        return jax.lax.conv_general_dilated(
+            x.astype(dtype),
+            jnp.asarray(kernel, dtype),
+            (stride, stride),
+            padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def depthwise(x, kernel):
+        return jax.lax.conv_general_dilated(
+            x.astype(dtype),
+            jnp.asarray(kernel, dtype),
+            (1, 1),
+            "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1],
+        )
+
+    def bn(x, p, s):
+        # flax BatchNorm(use_running_average=True, dtype=...): stats and
+        # params promoted to dtype, computed in dtype.
+        mean = jnp.asarray(s["mean"], dtype)
+        var = jnp.asarray(s["var"], dtype)
+        scale = jnp.asarray(p["scale"], dtype)
+        bias = jnp.asarray(p["bias"], dtype)
+        y = (x - mean) * jax.lax.rsqrt(var + jnp.asarray(KERAS_BN_EPS, dtype))
+        return y * scale + bias
+
+    def sepconv(x, p):
+        x = depthwise(x, p["depthwise"]["kernel"])
+        return conv(x, p["pointwise"]["kernel"])
+
+    pool = lambda x: nn.max_pool(  # noqa: E731 - mirrors models.xception
+        x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
+    )
+
+    def forward(variables, x):
+        p = variables["params"]
+        s = variables["batch_stats"]
+
+        # --- entry flow (flax-identical ops) ---
+        x = conv(x, p["block1_conv1"]["kernel"], stride=2, padding="VALID")
+        x = nn.relu(bn(x, p["block1_conv1_bn"], s["block1_conv1_bn"]))
+        x = conv(x, p["block1_conv2"]["kernel"], padding="VALID")
+        x = nn.relu(bn(x, p["block1_conv2_bn"], s["block1_conv2_bn"]))
+        for idx, _feat in _ENTRY_BLOCKS:
+            residual = conv(x, p[f"block{idx}_res_conv"]["kernel"], stride=2)
+            residual = bn(residual, p[f"block{idx}_res_bn"], s[f"block{idx}_res_bn"])
+            if idx > 2:
+                x = nn.relu(x)
+            x = sepconv(x, p[f"block{idx}_sepconv1"])
+            x = bn(x, p[f"block{idx}_sepconv1_bn"], s[f"block{idx}_sepconv1_bn"])
+            x = nn.relu(x)
+            x = sepconv(x, p[f"block{idx}_sepconv2"])
+            x = bn(x, p[f"block{idx}_sepconv2_bn"], s[f"block{idx}_sepconv2_bn"])
+            x = pool(x) + residual
+
+        # --- middle flow: fused Pallas chain in (H, W, B, C) layout ---
+        xt = x.transpose(1, 2, 0, 3)
+        for idx in _MIDDLE_BLOCKS:
+            dw, pw, scale, shift = middle_block_weights(p, s, f"block{idx}")
+            xt = fused_sepconv_block_t(xt, dw, pw, scale, shift, interpret=interpret)
+        x = xt.transpose(2, 0, 1, 3)
+
+        # --- exit flow (flax-identical ops) ---
+        residual = conv(x, p["block13_res_conv"]["kernel"], stride=2)
+        residual = bn(residual, p["block13_res_bn"], s["block13_res_bn"])
+        x = nn.relu(x)
+        x = sepconv(x, p["block13_sepconv1"])
+        x = bn(x, p["block13_sepconv1_bn"], s["block13_sepconv1_bn"])
+        x = nn.relu(x)
+        x = sepconv(x, p["block13_sepconv2"])
+        x = bn(x, p["block13_sepconv2_bn"], s["block13_sepconv2_bn"])
+        x = pool(x) + residual
+        x = sepconv(x, p["block14_sepconv1"])
+        x = nn.relu(bn(x, p["block14_sepconv1_bn"], s["block14_sepconv1_bn"]))
+        x = sepconv(x, p["block14_sepconv2"])
+        x = nn.relu(bn(x, p["block14_sepconv2_bn"], s["block14_sepconv2_bn"]))
+
+        # --- head (ClassifierHead semantics) ---
+        x = x.mean(axis=(1, 2))
+        head = p["head"]
+        i = 0
+        while f"hidden_{i}" in head:
+            h = head[f"hidden_{i}"]
+            x = nn.relu(
+                x @ jnp.asarray(h["kernel"], dtype) + jnp.asarray(h["bias"], dtype)
+            )
+            i += 1
+        logits = head["logits"]
+        return x @ jnp.asarray(logits["kernel"], dtype) + jnp.asarray(
+            logits["bias"], dtype
+        )
+
+    return forward

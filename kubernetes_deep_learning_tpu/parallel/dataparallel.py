@@ -59,7 +59,11 @@ def build_sharded_forward(spec: ModelSpec, mesh: Mesh, dtype: Any = jnp.bfloat16
     Returns ``f(sharded_variables, images) -> logits`` where images may be a
     host numpy array (it is device_put with batch sharding internally).
     """
-    forward = build_forward(spec, dtype=dtype)
+    # fast=False: the fused-Pallas path is validated for single-device
+    # serving; under jit-over-mesh the batch dim is sharded and the kernel's
+    # batch-tile picking would see the global (not per-shard) batch.  The
+    # sharded path keeps the flax graph until a shard_map'd fast path lands.
+    forward = build_forward(spec, dtype=dtype, fast=False)
     batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
     out_sharding = NamedSharding(mesh, P(DATA_AXIS))
 

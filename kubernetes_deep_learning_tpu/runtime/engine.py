@@ -44,6 +44,7 @@ class InferenceEngine:
         registry: metrics_lib.Registry | None = None,
         mesh=None,
         mesh_mode: str = "data",
+        fast: bool | str = "auto",
     ):
         """``mesh`` switches the engine to SPMD serving over the mesh.
         mesh_mode "data": the batch is sharded over the ``data`` axis
@@ -81,6 +82,33 @@ class InferenceEngine:
         # Compute dtype recorded at export time; the f32 debug path must use
         # the same dtype or it would disagree numerically with the wire path.
         self._compute_dtype = artifact.metadata.get("compute_dtype", "bfloat16")
+        # fast: forwarded to models.build_forward for the live-jit paths.
+        # Exact-parity consumers (golden verification) pass False so the
+        # flax graph -- not the approximate fused kernel -- is what gets
+        # checked (xception_fast.py's stated invariant).
+        self._fast = fast
+        # int8 weight-only artifacts (ops.quantize): weights stay int8 in
+        # HBM and dequantize inline inside the jit (fused into the convs'
+        # operand path -- the small-batch weight-bandwidth win).  Mesh
+        # serving dequantizes at load instead: the partition rules address
+        # float kernel leaves, not the {_q8, _q8_scale} wire form.
+        self._quantization = artifact.metadata.get("quantization") or None
+        if self._quantization is not None:
+            from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+            if self._quantization != quant_lib.SCHEME:
+                raise ValueError(
+                    f"unknown quantization scheme {self._quantization!r}"
+                )
+            if mesh is not None:
+                import dataclasses
+
+                artifact = dataclasses.replace(
+                    artifact,
+                    variables=jax.device_get(
+                        quant_lib.dequantize_variables(artifact.variables)
+                    ),
+                )
         if mesh is not None:
             import jax.numpy as jnp
 
@@ -118,7 +146,28 @@ class InferenceEngine:
             return
         self._variables = jax.device_put(artifact.variables, self._device)
         platform = self._device.platform
-        if use_exported and artifact.module_bytes_for(platform) is not None:
+        # On TPU, a family with a fused-Pallas fast path serves through the
+        # live-jit forward even when the artifact carries StableHLO: same
+        # variables, measurably faster program (models.xception_fast).  The
+        # exported module remains the portable format and the path for
+        # families with no in-tree model.
+        from kubernetes_deep_learning_tpu.models import has_fast_forward
+
+        prefer_live = (
+            platform == "tpu"
+            and has_fast_forward(self.spec)
+            # Same conditions build_forward's fast="auto" applies: without
+            # them, skipping the exported module would only buy a slower
+            # live re-trace of the flax graph.
+            and self._compute_dtype == "bfloat16"
+            and self._fast != False  # noqa: E712 - "auto" must stay truthy
+        )
+        if (
+            use_exported
+            and not prefer_live
+            and self._quantization is None  # modules are traced float-only
+            and artifact.module_bytes_for(platform) is not None
+        ):
             self._jitted = jax.jit(artifact.exported_for(platform).call)
             # The exported module is traced for the uint8 wire path only;
             # float32 "pre-normalized" input (protocol.decode_predict_request's
@@ -132,9 +181,7 @@ class InferenceEngine:
             # specializes per dtype, so one jitted fn serves both paths.
             import jax.numpy as jnp
 
-            self._jitted = jax.jit(
-                build_forward(self.spec, dtype=jnp.dtype(self._compute_dtype))
-            )
+            self._jitted = jax.jit(self._live_forward(jnp.dtype(self._compute_dtype)))
             self._jitted_f32 = self._jitted
         # The f32 debug path dispatches under its own lock: its lazy first
         # compile (tens of seconds on TPU) must never stall warm uint8
@@ -178,6 +225,21 @@ class InferenceEngine:
         self._ready.set()
         return dt
 
+    def _live_forward(self, dtype):
+        """The live-jit forward, with inline dequantization when the
+        artifact carries int8 weights."""
+        from kubernetes_deep_learning_tpu.models import build_forward
+
+        base = build_forward(self.spec, dtype=dtype, fast=self._fast)
+        if self._quantization is None:
+            return base
+        from kubernetes_deep_learning_tpu.ops.quantize import dequantize_variables
+
+        def forward(variables, images):
+            return base(dequantize_variables(variables), images)
+
+        return forward
+
     def _f32_forward(self):
         """Lazily build the float32 debug-path fn (exported artifacts only)."""
         if self._jitted_f32 is None:
@@ -186,10 +248,8 @@ class InferenceEngine:
                     import jax
                     import jax.numpy as jnp
 
-                    from kubernetes_deep_learning_tpu.models import build_forward
-
                     self._jitted_f32 = jax.jit(
-                        build_forward(self.spec, dtype=jnp.dtype(self._compute_dtype))
+                        self._live_forward(jnp.dtype(self._compute_dtype))
                     )
         return self._jitted_f32
 

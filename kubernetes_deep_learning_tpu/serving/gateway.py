@@ -32,8 +32,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.ops import preprocess
-from kubernetes_deep_learning_tpu.runtime import QueueFull
+from kubernetes_deep_learning_tpu.runtime import BatcherClosed, QueueFull
 from kubernetes_deep_learning_tpu.serving import protocol
+from kubernetes_deep_learning_tpu.serving.microbatch import UpstreamStall
 from kubernetes_deep_learning_tpu.serving.tracing import (
     REQUEST_ID_HEADER,
     ensure_request_id,
@@ -348,13 +349,19 @@ class Gateway:
             self._m_errors.inc()
             status = e.http_status
             return e.http_status, json.dumps({"error": str(e)}).encode(), "application/json"
-        except QueueFull as e:
-            # The upstream micro-batcher's transient overload signal: a
-            # retryable 503, exactly like the model tier's own QueueFull --
+        except (QueueFull, BatcherClosed, UpstreamStall) as e:
+            # Transient server-side conditions from the upstream
+            # micro-batcher (overload, shutdown race, hung upstream): a
+            # retryable 503, exactly like the model tier's own mapping --
             # NOT a 400, which clients would treat as a permanent error.
+            # (UpstreamStall is typed precisely so this clause does not
+            # have to catch TimeoutError, which would also swallow
+            # client-side image-fetch timeouts on Python >= 3.11.)
             self._m_errors.inc()
             status = 503
-            return 503, json.dumps({"error": f"overloaded: {e}"}).encode(), "application/json"
+            return 503, json.dumps(
+                {"error": f"upstream unavailable: {e}"}
+            ).encode(), "application/json"
         except Exception as e:
             # Bad JSON, missing "url", unfetchable/undecodable image:
             # genuinely the caller's fault.

@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
@@ -27,6 +28,16 @@ import numpy as np
 # matches the model tier's own batcher wait bound (runtime/batcher.py) and
 # comfortably exceeds the gateway's upstream read timeout.
 RESULT_TIMEOUT_S = 120.0
+
+
+class UpstreamStall(RuntimeError):
+    """The micro-batched upstream produced no result within the bound.
+
+    Typed (rather than letting concurrent.futures.TimeoutError escape) so
+    the gateway can map it to a retryable 503 without catching the builtin
+    TimeoutError -- which, on Python >= 3.11, IS futures.TimeoutError and
+    would swallow client-side image-fetch timeouts too.
+    """
 
 
 class UpstreamMicroBatcher:
@@ -60,19 +71,26 @@ class UpstreamMicroBatcher:
 
     def predict(self, image: np.ndarray, request_id: str = ""):
         """One image (H,W,C) -> (logit_row, labels); blocks until served."""
+        from kubernetes_deep_learning_tpu.runtime import BatcherClosed, QueueFull
+
         fut: Future = Future()
         with self._lock:
             if self._closed:
-                raise RuntimeError("upstream batcher is closed")
+                # Typed so the gateway maps shutdown races to a retryable
+                # 5xx, never a client-fault 400.
+                raise BatcherClosed("upstream batcher is closed")
             if len(self._queue) >= self._max_queue:
-                from kubernetes_deep_learning_tpu.runtime import QueueFull
-
                 raise QueueFull(
                     f"upstream batch queue at {self._max_queue} entries"
                 )
             self._queue.append((image, request_id, fut))
             self._nonempty.notify()
-        return fut.result(timeout=RESULT_TIMEOUT_S)
+        try:
+            return fut.result(timeout=RESULT_TIMEOUT_S)
+        except FuturesTimeout:
+            raise UpstreamStall(
+                f"no upstream response in {RESULT_TIMEOUT_S:.0f}s"
+            ) from None
 
     def _run(self) -> None:
         while True:

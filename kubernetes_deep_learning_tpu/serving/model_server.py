@@ -511,6 +511,80 @@ class ModelServer:
                 m.batcher.close(drain=False)
 
 
+def _serve_cross_host(args) -> int:
+    """--cross-host: leader serves HTTP, followers run the lockstep loop."""
+    import jax
+
+    from kubernetes_deep_learning_tpu.parallel.crosshost import (
+        CrossHostEngine,
+        CrossHostForward,
+    )
+    from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+
+    n = args.data_parallel or len(jax.devices())
+    mesh = make_mesh(
+        n, model_parallel=args.model_parallel, devices=jax.devices()[:n]
+    )
+    # Every process loads the same artifact (shared storage or identical
+    # image) and builds the same CrossHostForward; only the leader binds
+    # the HTTP socket.
+    (name,) = _single_model_name(args.models)
+    version = art.latest_version(args.models, name)
+    artifact = art.load_artifact(art.version_dir(args.models, name, version))
+    xh = CrossHostForward(
+        artifact.spec,
+        mesh,
+        artifact.variables,
+        bucket=args.cross_host_bucket,
+    )
+    # xh holds the (device-sharded) weights; drop the host-RAM copy before
+    # ModelServer loads its own artifact (whose copy CrossHostEngine also
+    # frees) -- large models must not sit in host memory twice for the
+    # server's lifetime.
+    del artifact
+    if jax.process_index() != 0:
+        print(
+            f"cross-host follower {jax.process_index()}/{jax.process_count()} "
+            "entering lockstep loop"
+        )
+        rounds = xh.follower_loop()
+        print(f"cross-host follower done after {rounds} rounds")
+        return 0
+
+    server = ModelServer(
+        args.models,
+        port=args.port,
+        buckets=(xh.bucket,),
+        use_batcher=not args.no_batching,
+        batcher_impl=args.batcher,
+        request_log=not args.no_request_log,
+        engine_factory=lambda artifact, **kw: CrossHostEngine(artifact, xh, **kw),
+    )
+    server.warmup()
+    print(
+        f"cross-host model server on :{server.port} "
+        f"({jax.process_count()} processes, {n} global devices)"
+    )
+    try:
+        server.start(block=True)
+    finally:
+        xh.shutdown()
+    return 0
+
+
+def _single_model_name(model_root: str) -> tuple[str]:
+    """Cross-host serving drives exactly one model; resolve its name."""
+    names = [
+        n for n in sorted(os.listdir(model_root))
+        if art.latest_version(model_root, n) is not None
+    ]
+    if len(names) != 1:
+        raise ValueError(
+            f"--cross-host serves exactly one model; {model_root!r} has {names}"
+        )
+    return (names[0],)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description="TPU model server")
     p.add_argument("--models", required=True, help="artifact root (/models)")
@@ -581,6 +655,21 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="disable the per-request traced log line (rid, model, batch, status)",
     )
+    p.add_argument(
+        "--cross-host",
+        action="store_true",
+        help="serve ONE model sharded across every process of the "
+        "multi-host runtime (requires the KDLT_COORDINATOR env triplet or "
+        "KDLT_MULTIHOST=1 on a TPU pod slice): process 0 runs the HTTP "
+        "frontend and broadcasts each dispatch; the other processes run "
+        "lockstep followers.  --data-parallel then counts GLOBAL devices.",
+    )
+    p.add_argument(
+        "--cross-host-bucket",
+        type=int,
+        default=0,
+        help="fixed dispatch batch for --cross-host (0 = data-axis size)",
+    )
     args = p.parse_args(argv)
 
     from kubernetes_deep_learning_tpu.utils.platform import force_platform
@@ -597,16 +686,23 @@ def main(argv: list[str] | None = None) -> int:
             f"{jax.process_count()}, {len(jax.devices())} global devices"
         )
 
+    if args.cross_host:
+        # One frontend, model sharded over every process: process 0 serves
+        # HTTP and broadcasts dispatches; the rest run lockstep followers
+        # (parallel.crosshost).  This is the cross-host mode the per-request
+        # local-mesh path below deliberately does not attempt.
+        return _serve_cross_host(args)
+
     mesh = None
     if args.data_parallel > 0:
         import jax
 
         from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
 
-        # LOCAL devices only: the per-request HTTP serving model cannot
-        # drive a cross-host SPMD program (every process would have to
-        # enter the same dispatch in lockstep with the same data).  Scaling
-        # across hosts is replica scaling, the reference's own mechanism.
+        # LOCAL devices only: without --cross-host the per-request HTTP
+        # handler cannot drive a cross-host SPMD program (every process
+        # must enter the same dispatch in lockstep).  Scaling across hosts
+        # is replica scaling (the reference's mechanism) or --cross-host.
         mesh = make_mesh(
             args.data_parallel,
             model_parallel=args.model_parallel,

@@ -22,9 +22,43 @@ from kubernetes_deep_learning_tpu.parallel.mesh import batch_sharding
 from kubernetes_deep_learning_tpu.training import checkpoint as ckpt_lib
 from kubernetes_deep_learning_tpu.training.data import PrefetchIterator
 from kubernetes_deep_learning_tpu.training.trainer import (
+    build_eval_step,
     build_train_step,
     create_train_state,
 )
+
+
+def evaluate(
+    spec: ModelSpec,
+    state,
+    batches: Iterable,
+    mesh=None,
+    eval_step: Callable | None = None,
+    topk: int = 5,
+) -> dict[str, float]:
+    """One validation pass: mean loss, top-1 and top-k accuracy.
+
+    ``batches`` yields (uint8 images, int labels); batches may be uneven --
+    aggregation is by per-example sums.  Pass a prebuilt ``eval_step`` when
+    calling repeatedly (fit does) to avoid re-jitting.
+    """
+    step_fn = eval_step or build_eval_step(spec, mesh=mesh, topk=topk)
+    sharding = batch_sharding(mesh) if mesh is not None else None
+    totals = {"loss_sum": 0.0, "top1_sum": 0.0, "topk_sum": 0.0, "count": 0.0}
+    for images, labels in batches:
+        if sharding is not None:
+            images = jax.device_put(images, sharding)
+            labels = jax.device_put(labels, sharding)
+        m = step_fn(state, images, labels)
+        for key in totals:
+            totals[key] += float(m[key])
+    n = max(totals["count"], 1.0)
+    return {
+        "val_loss": totals["loss_sum"] / n,
+        "val_top1": totals["top1_sum"] / n,
+        "val_topk": totals["topk_sum"] / n,
+        "count": int(totals["count"]),
+    }
 
 
 def fit(
@@ -41,8 +75,20 @@ def fit(
     log_fn: Callable[[str], None] = print,
     prefetch: int = 2,
     state: Any = None,
+    eval_batches: Callable[[], Iterable] | None = None,
+    eval_every: int = 0,
+    eval_history: list | None = None,
 ):
     """Train to ``steps`` total optimizer steps; returns (state, history).
+
+    Evaluation: ``eval_batches`` is a zero-arg factory returning a fresh
+    (images, labels) iterable (re-invoked per pass).  With ``eval_every``
+    set, a validation pass (loop.evaluate: mean loss, top-1/top-k accuracy)
+    runs at that step cadence and once after the final step; results go to
+    ``log_fn`` and, if a list is passed as ``eval_history``, are appended as
+    ``(step, metrics_dict)``.  Without ``eval_every`` a single pass runs at
+    the end.  The reference has no quality gate at all between training and
+    serving (SURVEY.md section 4).
 
     Resume semantics: with ``ckpt_dir`` set, an existing checkpoint is
     restored and training continues from its step counter -- a run killed at
@@ -65,6 +111,9 @@ def fit(
             log_fn(f"resumed from {ckpt_dir} at step {int(state.step)}")
 
     step_fn = build_train_step(spec, tx, mesh=mesh)
+    eval_fn = (
+        build_eval_step(spec, mesh=mesh) if eval_batches is not None else None
+    )
     sharding = batch_sharding(mesh) if mesh is not None else None
     it = PrefetchIterator(batches, sharding=sharding, depth=prefetch)
 
@@ -81,6 +130,16 @@ def fit(
         rate = (step - start_step) / max(time.perf_counter() - t0, 1e-9)
         log_fn(f"step {step}/{steps} loss {loss:.4f} ({rate:.1f} steps/s)")
 
+    def run_eval():
+        m = evaluate(spec, state, eval_batches(), mesh=mesh, eval_step=eval_fn)
+        if eval_history is not None:
+            eval_history.append((step, m))
+        log_fn(
+            f"eval step {step}: val_loss {m['val_loss']:.4f} "
+            f"val_top1 {m['val_top1']:.4f} val_topk {m['val_topk']:.4f} "
+            f"({m['count']} examples)"
+        )
+
     try:
         while step < steps:
             try:
@@ -92,6 +151,13 @@ def fit(
             step += 1
             if log_every and step % log_every == 0 and step < steps:
                 record()
+            if (
+                eval_fn is not None
+                and eval_every
+                and step % eval_every == 0
+                and step < steps
+            ):
+                run_eval()
             if ckpt is not None and ckpt_every and step % ckpt_every == 0:
                 ckpt.save(state)
     finally:
@@ -101,6 +167,11 @@ def fit(
 
     if metrics is not None:  # always record the final executed step
         record()
+    if eval_fn is not None:
+        # Final-quality pass regardless of cadence -- including zero-step
+        # runs (e.g. resumed already at `steps`): the caller asked for a
+        # quality gate, so evaluate the state we are about to hand back.
+        run_eval()
     if ckpt is not None:
         ckpt.save(state)  # no-op if this step was already snapshotted
         ckpt.wait()

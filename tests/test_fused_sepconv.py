@@ -1,0 +1,152 @@
+"""Fused sepconv kernel + Xception fast path, validated on CPU.
+
+The Pallas kernel runs in interpret mode here (tests are hermetic-CPU,
+conftest.py); the real-TPU speed claim is bench.py's job.  What IS pinned
+here: kernel-vs-reference numerics, BN folding against flax.linen.BatchNorm
+(including the Keras-parity epsilon), batch-tile picking rules, and the
+full fast-forward's logits against the stock flax graph on the same
+variables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.ops.fused_sepconv import (
+    fold_bn,
+    fused_sepconv_block,
+    middle_block_weights,
+    pick_batch_tile,
+    sepconv_block_reference,
+)
+
+
+def _random_block_weights(rng, c):
+    dw = jnp.asarray(rng.normal(0, 0.2, (3, 3, 3, c)), jnp.float32)
+    pw = jnp.asarray(rng.normal(0, 0.05, (3, c, c)), jnp.bfloat16)
+    s = jnp.asarray(rng.uniform(0.8, 1.2, (3, c)), jnp.float32)
+    b = jnp.asarray(rng.normal(0, 0.1, (3, c)), jnp.float32)
+    return dw, pw, s, b
+
+
+@pytest.mark.parametrize("shape", [(4, 6, 6, 256), (2, 5, 7, 128)])
+def test_kernel_matches_reference(shape):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, shape), jnp.bfloat16)
+    dw, pw, s, b = _random_block_weights(rng, shape[-1])
+    want = np.asarray(sepconv_block_reference(x, dw, pw, s, b), np.float32)
+    got = np.asarray(
+        jax.jit(lambda *a: fused_sepconv_block(*a, interpret=True))(x, dw, pw, s, b),
+        np.float32,
+    )
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+    assert rel < 2e-2, f"kernel diverges from reference: {rel:.2e}"
+
+
+def test_fold_bn_matches_flax_batchnorm():
+    import flax.linen as nn
+
+    from kubernetes_deep_learning_tpu.models.layers import KERAS_BN_EPS, batch_norm
+
+    rng = np.random.default_rng(1)
+    c = 32
+    x = jnp.asarray(rng.normal(0, 1, (4, c)), jnp.float32)
+    p = {
+        "scale": jnp.asarray(rng.uniform(0.8, 1.2, c), jnp.float32),
+        "bias": jnp.asarray(rng.normal(0, 0.1, c), jnp.float32),
+    }
+    s = {
+        "mean": jnp.asarray(rng.normal(0, 0.5, c), jnp.float32),
+        "var": jnp.asarray(rng.uniform(0.5, 1.5, c), jnp.float32),
+    }
+    mod = batch_norm(False, None, "bn")
+    want = mod.apply({"params": p, "batch_stats": s}, x)
+    scale, shift = fold_bn(p, s)  # defaults to the Keras epsilon
+    got = x * scale + shift
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+    # and it is the KERAS epsilon, not flax's 1e-5 default
+    assert KERAS_BN_EPS == 1e-3
+    bad_scale, _ = fold_bn(p, s, eps=1e-5)
+    assert not np.allclose(np.asarray(bad_scale), np.asarray(scale))
+
+
+def test_pick_batch_tile_rules():
+    # divisible batches take the largest tile under budget
+    assert pick_batch_tile(256, 19, 19, 728) == 16
+    assert pick_batch_tile(8, 19, 19, 728) == 8
+    # huge spatial extents fall back to the smallest aligned tile
+    assert pick_batch_tile(256, 74, 74, 728) == 8
+    # non-multiple-of-8 batches must use the whole batch (Mosaic constraint)
+    assert pick_batch_tile(6, 19, 19, 728) == 6
+    assert pick_batch_tile(12, 19, 19, 728) == 12
+
+
+@pytest.fixture(scope="module")
+def fast_spec():
+    return register_spec(
+        ModelSpec(
+            name="fast-xception",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("a", "b", "c", "d"),
+            preprocessing="tf",
+            head_hidden=(16,),
+        )
+    )
+
+
+def test_fast_forward_matches_flax(fast_spec):
+    """Full fast path (entry/exit lax ops + fused middle, interpret mode)
+    vs the stock flax graph on identical variables with jittered BN stats."""
+    from kubernetes_deep_learning_tpu.models.xception_fast import build_fast_forward
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    rng = np.random.default_rng(2)
+    variables = jax.tree_util.tree_map(np.asarray, init_variables(fast_spec, seed=3))
+
+    def jitter(tree):
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                jitter(v)
+            elif k == "mean":
+                tree[k] = rng.normal(0, 0.05, v.shape).astype(np.float32)
+            elif k == "var":
+                tree[k] = rng.uniform(0.5, 1.5, v.shape).astype(np.float32)
+
+    jitter(variables["batch_stats"])
+
+    images = rng.integers(0, 256, (2, *fast_spec.input_shape), np.uint8)
+    ref = jax.jit(build_forward(fast_spec, dtype=jnp.bfloat16, fast=False))
+    want = np.asarray(ref(variables, images))
+
+    fast = build_fast_forward(fast_spec, dtype=jnp.bfloat16, interpret=True)
+    x = normalize(jnp.asarray(images), fast_spec.preprocessing)
+    got = np.asarray(jax.jit(fast)(variables, x), np.float32)
+
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+    assert rel < 1e-2, f"fast path diverges from flax graph: {rel:.2e}"
+
+
+def test_middle_block_weights_shapes(fast_spec):
+    variables = init_variables(fast_spec, seed=0)
+    dw, pw, s, b = middle_block_weights(
+        variables["params"], variables["batch_stats"], "block5"
+    )
+    assert dw.shape == (3, 3, 3, 728) and dw.dtype == jnp.float32
+    assert pw.shape == (3, 728, 728) and pw.dtype == jnp.bfloat16
+    assert s.shape == (3, 728) and b.shape == (3, 728)
+
+
+def test_build_forward_fast_flag_dispatch(fast_spec):
+    """fast='auto' on the CPU backend must stay on the flax graph (pallas
+    TPU kernels cannot lower for CPU outside interpret mode)."""
+    fwd = build_forward(fast_spec, dtype=jnp.bfloat16)  # auto
+    images = np.zeros((1, *fast_spec.input_shape), np.uint8)
+    variables = init_variables(fast_spec, seed=0)
+    out = jax.jit(fwd)(variables, images)
+    assert out.shape == (1, fast_spec.num_classes)

@@ -24,6 +24,22 @@ def train_setup():
     return spec, tx
 
 
+@pytest.fixture(scope="module")
+def tiny_train_spec():
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+
+    return register_spec(
+        ModelSpec(
+            name="eval-vit",
+            family="vit-tiny",
+            input_shape=(16, 16, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+            description="test-only eval-path model",
+        )
+    )
+
+
 def _batch(spec, n=8, seed=0):
     rng = np.random.default_rng(seed)
     images = rng.integers(0, 256, size=(n, *spec.input_shape), dtype=np.uint8)
@@ -75,3 +91,73 @@ def test_sharded_and_single_device_grads_agree(train_setup):
     a = np.asarray(state1.params["head"]["logits"]["kernel"])
     b = np.asarray(state2.params["head"]["logits"]["kernel"])
     np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+
+def test_eval_step_and_evaluate(tiny_train_spec):
+    """build_eval_step sums are exact; evaluate() aggregates uneven batches."""
+    import optax
+
+    from kubernetes_deep_learning_tpu.training.loop import evaluate
+    from kubernetes_deep_learning_tpu.training.trainer import (
+        build_eval_step,
+        create_train_state,
+    )
+
+    spec = tiny_train_spec
+    state = create_train_state(spec, optax.sgd(1e-3), seed=0)
+    rng = np.random.default_rng(0)
+
+    def batches():
+        for n in (3, 5):  # uneven on purpose
+            yield (
+                rng.integers(0, 256, (n, *spec.input_shape), np.uint8),
+                rng.integers(0, spec.num_classes, (n,), np.int32),
+            )
+
+    m = evaluate(spec, state, batches())
+    assert m["count"] == 8
+    assert 0.0 <= m["val_top1"] <= m["val_topk"] <= 1.0
+    assert np.isfinite(m["val_loss"])
+    # topk capped at num_classes => every example is in the top-k
+    if spec.num_classes <= 5:
+        assert m["val_topk"] == 1.0
+
+    step = build_eval_step(spec)
+    imgs = rng.integers(0, 256, (4, *spec.input_shape), np.uint8)
+    lbls = rng.integers(0, spec.num_classes, (4,), np.int32)
+    out = step(state, imgs, lbls)
+    assert int(out["count"]) == 4
+    assert 0 <= int(out["top1_sum"]) <= 4
+
+
+def test_fit_runs_periodic_and_final_eval(tiny_train_spec):
+    import optax
+
+    from kubernetes_deep_learning_tpu.training import fit, synthetic_batches
+
+    spec = tiny_train_spec
+    logs: list[str] = []
+    eval_hist: list = []
+
+    def eval_batches():
+        return synthetic_batches(spec, 4, steps=2, seed=9)
+
+    state, hist = fit(
+        spec,
+        optax.sgd(1e-3),
+        synthetic_batches(spec, 4, steps=4),
+        steps=4,
+        log_fn=logs.append,
+        eval_batches=eval_batches,
+        eval_every=2,
+        eval_history=eval_hist,
+    )
+    assert int(state.step) == 4
+    assert hist[-1][0] == 4  # train history shape unchanged
+    # periodic eval at step 2 + final eval at step 4
+    steps_evaled = [s for s, _ in eval_hist]
+    assert steps_evaled == [2, 4]
+    for _, m in eval_hist:
+        assert set(m) >= {"val_loss", "val_top1", "val_topk", "count"}
+        assert m["count"] == 8
+    assert sum("eval step" in line for line in logs) == 2
