@@ -474,9 +474,9 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="clothing-model",
                    help="ModelSpec name to bench (see modelspec.list_specs)")
-    # 1..128 is BASELINE.json's sweep; 256/1024 probe the throughput ceiling
-    # within the p50<=15ms bound (batch 1024 stays ~12ms on v5e).
-    p.add_argument("--batches", default="1,2,4,8,16,32,64,128,256,1024")
+    # 1..128 is BASELINE.json's sweep; 48/56 bracket the p50<=15ms latency
+    # bound on v5e; 256/1024 probe the unbound throughput ceiling.
+    p.add_argument("--batches", default="1,2,4,8,16,32,48,56,64,128,256,1024")
     p.add_argument("--scan-len", type=int, default=30, help="fwd passes per timed call")
     p.add_argument("--reps", type=int, default=5, help="timed calls per batch size")
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])

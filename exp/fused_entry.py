@@ -128,31 +128,64 @@ def fused_entry(a_t, w, *, bt=8, rt=10, interpret=False):
         g_r = pl.program_id(0)
         a = a_ref[0]  # (ht_a, Wp, bt, 32)
 
-        # --- conv2 3x3 VALID: 9 shifted GEMMs (K=32), accumulated ----------
-        z = None
-        for dh in range(3):
-            for dwc in range(3):
-                sl = a[dh : dh + ht_b, dwc : dwc + H_B, :, :]
-                t = jax.lax.dot_general(
-                    sl.reshape(ht_b * H_B * bt, C_IN),
-                    cv_ref[dh, dwc].astype(jnp.bfloat16),
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                z = t if z is None else z + t
+        # --- conv2 3x3 VALID: im2col on lanes -> ONE K=288 GEMM ------------
+        # (9 accumulated K=32 GEMMs waste 3/4 of each MXU pass.)
+        patches = jnp.concatenate(
+            [
+                a[dh : dh + ht_b, dwc : dwc + H_B, :, :]
+                for dh in range(3)
+                for dwc in range(3)
+            ],
+            axis=-1,
+        )  # (ht_b, 147, bt, 288), taps (dh, dwc)-major like cv's reshape
+        z = jax.lax.dot_general(
+            patches.reshape(ht_b * H_B * bt, 9 * C_IN),
+            cv_ref[...].reshape(9 * C_IN, C_B).astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         b = jnp.maximum(z * cvs_ref[...] + cvb_ref[...], 0).astype(
             jnp.bfloat16
         ).reshape(ht_b, H_B, bt, C_B)
 
-        # Validity of local b rows: global b row = 2*rt*g - 3 + L.
+        # Validity of local b rows: global b row = 2*rt*g - 3 + L.  The mask
+        # carries full (bt, C) extent: Mosaic cannot broadcast one value
+        # over sublanes AND lanes at once, but broadcasting over the
+        # untiled dim 1 is free.
         row0_b = 2 * rt * g_r - 3
-        rows = jax.lax.broadcasted_iota(jnp.int32, (ht_b, 1, 1, 1), 0) + row0_b
-        valid_b = ((rows >= 0) & (rows < H_B)).astype(jnp.bfloat16)
-        b = b * valid_b
+
+        def row_mask(c):
+            rows = (
+                jax.lax.broadcasted_iota(jnp.int32, (ht_b, 1, bt, c), 0)
+                + row0_b
+            )
+            return (rows >= 0) & (rows < H_B)  # bool (int compares only:
+            # Mosaic has no bf16 comparison)
+
+        valid_b = row_mask(C_B)
+        b = b * valid_b.astype(jnp.bfloat16)
 
         # --- residual: 1x1 stride-2 on b (row0_b odd: local 3,5,... are the
-        # global even rows 2*rt*g, 2*rt*g + 2, ...) ------------------------
-        b_even = b[3::2, ::2, :, :]
+        # global even rows 2*rt*g, 2*rt*g + 2, ...).  Stride-2 selection is
+        # slice+reshape on OUTER dims (a double-strided slice lowers to an
+        # unsupported gather in Mosaic). ------------------------------------
+        def every_other(x, start, count, axis):
+            idx = [slice(None)] * x.ndim
+            idx[axis] = slice(start, start + 2 * count)
+            x = x[tuple(idx)]
+            shape = list(x.shape)
+            shape[axis : axis + 1] = [count, 2]
+            x = x.reshape(shape)
+            idx = [slice(None)] * x.ndim
+            idx[axis + 1] = 0
+            out = x[tuple(idx)]
+            return out.reshape(
+                [s for i, s in enumerate(x.shape) if i != axis + 1]
+            )
+
+        b_rows = every_other(b, 3, rt + 1, 0)  # (rt+1, 147, bt, C_B)
+        b_rows = jnp.pad(b_rows, ((0, 0), (0, 1), (0, 0), (0, 0)))  # cols 148
+        b_even = every_other(b_rows, 0, (H_B + 1) // 2, 1)
         hr, wr = b_even.shape[0], b_even.shape[1]
         r = jax.lax.dot_general(
             b_even.reshape(hr * wr * bt, C_B),
@@ -186,7 +219,8 @@ def fused_entry(a_t, w, *, bt=8, rt=10, interpret=False):
         c = jnp.maximum(c * s1_ref[...] + b1_ref[...], 0).astype(
             jnp.bfloat16
         ).reshape(ht_b, H_B, bt, C_OUT)
-        c = c * valid_b  # re-zero rows the BN bias contaminated
+        valid_out = row_mask(C_OUT)
+        c = c * valid_out.astype(jnp.bfloat16)  # re-zero contaminated rows
 
         d = dw(c, dw2_ref[...])
         d = jax.lax.dot_general(
@@ -197,18 +231,25 @@ def fused_entry(a_t, w, *, bt=8, rt=10, interpret=False):
         )
         d = (d * s2_ref[...] + b2_ref[...]).reshape(ht_b, H_B, bt, C_OUT)
         # Invalid rows must lose the max-pool, not win it.
-        d = jnp.where(valid_b > 0, d, -1e9).astype(jnp.bfloat16)
+        d = jnp.where(valid_out, d, -1e9).astype(jnp.bfloat16)
         # SAME pool (1,1) col padding: out col c's window is cols 2c-1..2c+1.
         d = jnp.pad(d, ((0, 0), (1, 1), (0, 0), (0, 0)), constant_values=-1e9)
 
         # --- maxpool 3x3/2 + residual --------------------------------------
         # Out row j of this tile: window d rows 2*(rt*g+j)-1 .. +1, local
         # (with row0_b = 2*rt*g - 3) = 2j+2 .. 2j+4; padded cols give
-        # window col index 2c + dwc.
+        # window col index 2c + dwc.  Same slice+reshape stride-2 trick.
+        # d is (ht_b, 149, bt, C_OUT) after the col pad; pad one more col so
+        # stride-2 col selections of 75 entries stay in range, plus a spare
+        # row for the dh=2 slice of the last window.
+        d = jnp.pad(
+            d, ((0, 1), (0, 1), (0, 0), (0, 0)), constant_values=-1e9
+        )
         pooled = None
         for dh in range(3):
             for dwc in range(3):
-                sl = d[2 + dh :: 2, dwc :: 2, :, :][:rt, :H_OUT, :, :]
+                sl = every_other(d, 2 + dh, rt, 0)
+                sl = every_other(sl, dwc, H_OUT, 1)
                 pooled = sl if pooled is None else jnp.maximum(pooled, sl)
         o_ref[0] = pooled + r[:rt, :H_OUT, :, :]
 

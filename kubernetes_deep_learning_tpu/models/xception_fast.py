@@ -26,8 +26,11 @@ import jax.numpy as jnp
 from kubernetes_deep_learning_tpu.models.layers import KERAS_BN_EPS
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.ops.fused_sepconv import (
+    fold_bn,
     fused_sepconv_block_t,
+    fused_sepconv_chain_t,
     middle_block_weights,
+    sepconv_stage_weights,
 )
 
 _ENTRY_BLOCKS = ((2, 128), (3, 256), (4, 728))  # keep in sync with models.xception
@@ -103,30 +106,61 @@ def build_fast_forward(
             x = bn(x, p[f"block{idx}_sepconv2_bn"], s[f"block{idx}_sepconv2_bn"])
             x = pool(x) + residual
 
-        # --- middle flow: fused Pallas chain in (H, W, B, C) layout ---
+        # --- middle + exit flows: fused Pallas chains, one transpose in ---
+        # Everything from here to the head pool stays in (H, W, B, C): the
+        # exit flow's pool/residual are layout-agnostic XLA ops, so the
+        # transpose back never happens -- the head mean reduces over the
+        # leading spatial axes directly.
         xt = x.transpose(1, 2, 0, 3)
         for idx in _MIDDLE_BLOCKS:
             dw, pw, scale, shift = middle_block_weights(p, s, f"block{idx}")
             xt = fused_sepconv_block_t(xt, dw, pw, scale, shift, interpret=interpret)
-        x = xt.transpose(2, 0, 1, 3)
 
-        # --- exit flow (flax-identical ops) ---
-        residual = conv(x, p["block13_res_conv"]["kernel"], stride=2)
-        residual = bn(residual, p["block13_res_bn"], s["block13_res_bn"])
-        x = nn.relu(x)
-        x = sepconv(x, p["block13_sepconv1"])
-        x = bn(x, p["block13_sepconv1_bn"], s["block13_sepconv1_bn"])
-        x = nn.relu(x)
-        x = sepconv(x, p["block13_sepconv2"])
-        x = bn(x, p["block13_sepconv2_bn"], s["block13_sepconv2_bn"])
-        x = pool(x) + residual
-        x = sepconv(x, p["block14_sepconv1"])
-        x = nn.relu(bn(x, p["block14_sepconv1_bn"], s["block14_sepconv1_bn"]))
-        x = sepconv(x, p["block14_sepconv2"])
-        x = nn.relu(bn(x, p["block14_sepconv2_bn"], s["block14_sepconv2_bn"]))
+        # block13: residual 1x1/2 conv in XLA; the two sepconvs fused.
+        res_scale, res_shift = fold_bn(p["block13_res_bn"], s["block13_res_bn"])
+        res = jnp.einsum(
+            "hwbc,cd->hwbd",
+            xt[::2, ::2],
+            jnp.asarray(p["block13_res_conv"]["kernel"], dtype)[0, 0],
+        )
+        res = (res.astype(jnp.float32) * res_scale + res_shift).astype(dtype)
+        y13 = fused_sepconv_chain_t(
+            xt,
+            [
+                sepconv_stage_weights(
+                    p, s, "block13_sepconv1", "block13_sepconv1_bn",
+                    pre_relu=True, post_relu=False,
+                ),
+                sepconv_stage_weights(
+                    p, s, "block13_sepconv2", "block13_sepconv2_bn",
+                    pre_relu=True, post_relu=False,
+                ),
+            ],
+            interpret=interpret,
+        )
+        pooled = jax.lax.reduce_window(
+            y13, -jnp.inf, jax.lax.max, (3, 3, 1, 1), (2, 2, 1, 1), "SAME"
+        )
+        xt = pooled + res
 
-        # --- head (ClassifierHead semantics) ---
-        x = x.mean(axis=(1, 2))
+        # block14: two sepconvs (sep -> bn -> relu pattern), fused.
+        xt = fused_sepconv_chain_t(
+            xt,
+            [
+                sepconv_stage_weights(
+                    p, s, "block14_sepconv1", "block14_sepconv1_bn",
+                    pre_relu=False, post_relu=True,
+                ),
+                sepconv_stage_weights(
+                    p, s, "block14_sepconv2", "block14_sepconv2_bn",
+                    pre_relu=False, post_relu=True,
+                ),
+            ],
+            interpret=interpret,
+        )
+
+        # --- head (ClassifierHead semantics; spatial = leading axes) ---
+        x = xt.mean(axis=(0, 1))
         head = p["head"]
         i = 0
         while f"hidden_{i}" in head:

@@ -379,21 +379,17 @@ def _attn_bwd(causal: bool, res, dout):
     # D_i = sum_d dO_i * O_i, the softmax-backward row correction.
     delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # (B,H,Sq)
 
-    # No KV-block skipping under the causal mask: without q-tiling every KV
-    # block is visible to SOME later query row, so there are no fully-masked
-    # blocks to skip (unlike the forward kernel, which bounds its stream per
-    # 128-row q tile).  A 2D-tiled backward would reclaim the triangular
-    # FLOPs for causal training; noted as headroom, not needed by the
-    # (non-causal) ViT path.
+    if causal:
+        return _attn_bwd_2d(q32, k, v, do32, lse, delta, scale, block, q.dtype)
+
+    # Bidirectional: every (q, kv) pair contributes, so there is nothing to
+    # skip and the single-level KV scan has the least loop overhead.
     def body(dq_acc, j):
         k_j = jax.lax.dynamic_slice_in_dim(k, j * block, block, axis=2)
         v_j = jax.lax.dynamic_slice_in_dim(v, j * block, block, axis=2)
         k32 = k_j.astype(jnp.float32)
         v32 = v_j.astype(jnp.float32)
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
-        if causal:
-            mask = _causal_mask(0, j * block, sq, block)
-            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[..., None])          # (B,H,Sq,block), recomputed
         dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
         dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
@@ -410,6 +406,84 @@ def _attn_bwd(causal: bool, res, dout):
     dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, sk, d)
     dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, sk, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _attn_bwd_2d(q32, k, v, do32, lse, delta, scale, block, q_dtype):
+    """Causal backward, 2D-tiled: (q block x kv block) pairs strictly above
+    the diagonal are SKIPPED via lax.cond, reclaiming the triangular FLOPs
+    the round-1 backward paid (its single-level KV scan had no q tiling, so
+    no block was ever fully masked).  Memory stays O(S * block)."""
+    b, h, sq, d = q32.shape
+    sk = k.shape[2]
+    block_q = pick_block(sq) or sq
+    nq, nk = sq // block_q, sk // block
+
+    def kv_body(dq_full, j):
+        k32 = jax.lax.dynamic_slice_in_dim(k, j * block, block, axis=2).astype(
+            jnp.float32
+        )
+        v32 = jax.lax.dynamic_slice_in_dim(v, j * block, block, axis=2).astype(
+            jnp.float32
+        )
+
+        def q_body(carry, i):
+            dq_full, dk_acc, dv_acc = carry
+            q_i = jax.lax.dynamic_slice_in_dim(q32, i * block_q, block_q, axis=2)
+            do_i = jax.lax.dynamic_slice_in_dim(do32, i * block_q, block_q, axis=2)
+            lse_i = jax.lax.dynamic_slice_in_dim(lse, i * block_q, block_q, axis=2)
+            dl_i = jax.lax.dynamic_slice_in_dim(delta, i * block_q, block_q, axis=2)
+
+            def compute(args):
+                dq_full, dk_acc, dv_acc = args
+                s = jnp.einsum("bhqd,bhkd->bhqk", q_i, k32) * scale
+                rows = (
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block), 0)
+                    + i * block_q
+                )
+                cols = (
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block), 1)
+                    + j * block
+                )
+                s = jnp.where(rows >= cols, s, NEG_INF)
+                p = jnp.exp(s - lse_i[..., None])
+                dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_i)
+                dp = jnp.einsum("bhqd,bhkd->bhqk", do_i, v32)
+                ds = p * (dp - dl_i[..., None])
+                dq_i = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+                dq_full = jax.lax.dynamic_update_slice_in_dim(
+                    dq_full,
+                    jax.lax.dynamic_slice_in_dim(
+                        dq_full, i * block_q, block_q, axis=2
+                    )
+                    + dq_i,
+                    i * block_q,
+                    axis=2,
+                )
+                dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_i) * scale
+                return dq_full, dk_acc, dv_acc
+
+            # Skip pairs strictly above the diagonal: the last row of q
+            # block i is i*bq + bq - 1; it sees no key >= that + 1.
+            visible = (i + 1) * block_q > j * block
+            return jax.lax.cond(visible, compute, lambda a: a, carry), None
+
+        (dq_full, dk_j, dv_j), _ = jax.lax.scan(
+            q_body,
+            (
+                dq_full,
+                jnp.zeros((b, h, block, d), jnp.float32),
+                jnp.zeros((b, h, block, d), jnp.float32),
+            ),
+            jnp.arange(nq),
+        )
+        return dq_full, (dk_j, dv_j)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        kv_body, jnp.zeros(q32.shape, jnp.float32), jnp.arange(nk)
+    )
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, sk, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, sk, d)
+    return dq.astype(q_dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 attention_trainable.defvjp(_attn_fwd, _attn_bwd)

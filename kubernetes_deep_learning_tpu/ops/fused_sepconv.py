@@ -190,3 +190,105 @@ def fused_sepconv_block(x, dw, pw, scale, shift, *, bt: int = 0, interpret: bool
     xt = x.transpose(1, 2, 0, 3)
     out = fused_sepconv_block_t(xt, dw, pw, scale, shift, bt=bt, interpret=interpret)
     return out.transpose(2, 0, 1, 3)
+
+
+def fused_sepconv_chain_t(
+    xt,
+    stages,
+    *,
+    bt: int = 0,
+    interpret: bool = False,
+):
+    """A chain of sepconv+BN stages in one kernel, (H, W, B, C) layout.
+
+    ``stages``: sequence of dicts with keys ``dw`` (3,3,C_in) f32, ``pw``
+    (C_in, C_out) bf16, ``scale``/``shift`` (C_out,) f32, ``pre_relu`` /
+    ``post_relu`` bools -- covering both Xception exit patterns
+    (block13: relu -> sep -> bn; block14: sep -> bn -> relu).  No residual,
+    no pooling: those stay in XLA around the call.  Channel widths may grow
+    along the chain (728 -> 1024 -> 1536 -> 2048 in the exit flow).
+
+    Same layout argument as fused_sepconv_block_t: depthwise shifts move
+    only along untiled outer dims; each pointwise GEMM takes the whole
+    (H*W*bt) row extent.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    H, W, B, C0 = xt.shape
+    bt = bt or pick_batch_tile(B, H, W, max(s["pw"].shape[1] for s in stages))
+    bt = min(bt, B)
+    assert B % bt == 0, (B, bt)
+    c_out_final = stages[-1]["pw"].shape[1]
+    pre = tuple(bool(s["pre_relu"]) for s in stages)
+    post = tuple(bool(s["post_relu"]) for s in stages)
+
+    def kernel(x_ref, *refs):
+        o_ref = refs[-1]
+        stage_refs = [refs[i * 4 : i * 4 + 4] for i in range(len(stages))]
+        y = x_ref[...]
+        for i, (dw_ref, pw_ref, s_ref, b_ref) in enumerate(stage_refs):
+            c_in = y.shape[-1]
+            if pre[i]:
+                y = jnp.maximum(y, 0)
+            yp = jnp.pad(y, ((1, 1), (1, 1), (0, 0), (0, 0)))
+            acc = jnp.zeros((H, W, bt, c_in), jnp.float32)
+            for dh in range(3):
+                for dwc in range(3):
+                    tap = dw_ref[dh, dwc, :].astype(jnp.float32)
+                    acc = acc + (
+                        yp[dh : dh + H, dwc : dwc + W, :, :].astype(jnp.float32) * tap
+                    )
+            z = jax.lax.dot_general(
+                acc.astype(jnp.bfloat16).reshape(H * W * bt, c_in),
+                pw_ref[...],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            z = z * s_ref[...] + b_ref[...]
+            if post[i]:
+                z = jnp.maximum(z, 0)
+            y = z.astype(jnp.bfloat16).reshape(H, W, bt, pw_ref.shape[1])
+        o_ref[...] = y
+
+    in_specs = [pl.BlockSpec((H, W, bt, C0), lambda g: (0, 0, g, 0))]
+    args = [xt]
+    for s in stages:
+        c_in, c_out = s["pw"].shape
+        in_specs += [
+            pl.BlockSpec((3, 3, c_in), lambda g: (0, 0, 0)),
+            pl.BlockSpec((c_in, c_out), lambda g: (0, 0)),
+            pl.BlockSpec((c_out,), lambda g: (0,)),
+            pl.BlockSpec((c_out,), lambda g: (0,)),
+        ]
+        args += [s["dw"], s["pw"], s["scale"], s["shift"]]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(B // bt,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((H, W, bt, c_out_final), lambda g: (0, 0, g, 0)),
+        out_shape=jax.ShapeDtypeStruct((H, W, B, c_out_final), xt.dtype),
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(*args)
+
+
+def sepconv_stage_weights(params: dict, stats: dict, sep_name: str, bn_name: str,
+                          pre_relu: bool, post_relu: bool):
+    """One chain stage from the flax tree (see middle_block_weights)."""
+    import jax.numpy as jnp
+
+    sep = params[sep_name]
+    scale, shift = fold_bn(params[bn_name], stats[bn_name])
+    return {
+        "dw": jnp.asarray(sep["depthwise"]["kernel"], jnp.float32)[:, :, 0, :],
+        "pw": jnp.asarray(sep["pointwise"]["kernel"], jnp.float32)[0, 0].astype(
+            jnp.bfloat16
+        ),
+        "scale": scale,
+        "shift": shift,
+        "pre_relu": pre_relu,
+        "post_relu": post_relu,
+    }

@@ -102,6 +102,27 @@ def dequantize_variables(variables: Any, dtype: Any = None) -> Any:
     return walk(variables)
 
 
+def dequantize_variables_host(variables: Any) -> Any:
+    """Host-side (numpy) dequantization to float32.
+
+    For load-time consumers (mesh sharding, cross-host setup) that must not
+    round-trip the full f32 tree through a device -- the jnp variant would
+    briefly materialize 4x the int8 footprint on one chip at startup.
+    """
+    import numpy as np
+
+    def walk(tree):
+        if _is_quantized_leaf(tree):
+            return np.asarray(tree[QUANT_KEY], np.float32) * np.asarray(
+                tree[SCALE_KEY], np.float32
+            )
+        if isinstance(tree, dict):
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(variables)
+
+
 def is_quantized(variables: Any) -> bool:
     found = False
 

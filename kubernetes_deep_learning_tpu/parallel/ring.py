@@ -118,11 +118,89 @@ def ring_attention(
 def _ring_shard(
     q_blk, k_blk, v_blk, *, axis_name: str, n: int, causal: bool, use_flash: bool | None
 ):
-    """Per-device body: local q vs rotating KV shards, merged partials."""
+    """Per-device body: local q vs rotating KV shards, merged partials.
+
+    One schedule implementation only: this is the with-lse variant with the
+    lse dropped, so the inference and training (trainable-ring) paths can
+    never desynchronize.
+    """
+    out, _ = _ring_shard_with_lse(
+        q_blk, k_blk, v_blk, axis_name=axis_name, n=n, causal=causal,
+        use_flash=use_flash,
+    )
+    return out
+
+
+# --- trainable ring attention ----------------------------------------------
+# Round 1 deferred gradients through the ring: the fused flash attend has no
+# VJP, so context-parallel TRAINING forced the einsum attend, materializing
+# (S_local, S_local) scores (ROADMAP r1).  The custom_vjp below closes it:
+#
+# - forward: the same flash ring (partials + log-sum-exp merge), saving only
+#   out and the per-row lse -- O(S_local * D) residuals;
+# - backward: a SECOND ring.  Each device recomputes score blocks of
+#   (q_local x kv_src) from q, k and the saved GLOBAL lse (exactly the
+#   FlashAttention-2 recomputation, so no (S, S) tensor ever exists), adds
+#   the shard's (dk, dv) into an accumulator that rotates WITH the shard --
+#   after n hops every dkv lands back on its owner -- and dq accumulates
+#   locally.  Causal skipping mirrors the forward (a future shard's grads
+#   are identically zero, so the cond skips the whole pair).
+
+
+def _pair_grads(q32, k_j, v_j, lse, delta, do32, *, causal: bool, scale: float):
+    """Gradients of one (q_local, kv_shard) pair given the global lse.
+
+    Scans over KV blocks within the shard so peak memory is
+    O(S_local * block), not O(S_local^2).  causal=True means this is the
+    DIAGONAL pair (same shard: lower-triangular mask at offset 0).
+    """
+    sk = k_j.shape[2]
+    block = _flash_block(sk) or sk
+    nk = sk // block
+    sq = q32.shape[2]
+
+    def body(dq_acc, j):
+        k_b = jax.lax.dynamic_slice_in_dim(k_j, j * block, block, axis=2).astype(
+            jnp.float32
+        )
+        v_b = jax.lax.dynamic_slice_in_dim(v_j, j * block, block, axis=2).astype(
+            jnp.float32
+        )
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_b) * scale
+        if causal:
+            # j * block is traced (scan counter); the iota mask handles it.
+            rows = jax.lax.broadcasted_iota(jnp.int32, (sq, block), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (sq, block), 1) + j * block
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v_b)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_b) * scale
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        body, jnp.zeros(q32.shape, jnp.float32), jnp.arange(nk)
+    )
+    b, h = q32.shape[:2]
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, sk, -1)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, sk, -1)
+    return dq, dk, dv
+
+
+def _ring_shard_with_lse(
+    q_blk, k_blk, v_blk, *, axis_name, n, causal, use_flash
+):
+    """The ring schedule, returning (out, lse).
+
+    The single implementation of the rotation/skip schedule: _ring_shard
+    (inference) drops the lse; build_ring_attention_trainable's forward
+    saves it for the backward ring.
+    """
     s_local = q_blk.shape[2]
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
-
     block = _flash_block(s_local)
     kv_bytes = 2 * s_local * k_blk.shape[-1] * jnp.dtype(k_blk.dtype).itemsize
     if use_flash is None:
@@ -132,20 +210,11 @@ def _ring_shard(
             f"use_flash=True but local sequence {s_local} has no MXU tiling"
         )
 
-    def attend(kv_pair, *, causal: bool, k_offset: int):
-        # The shard's global offset only matters under the causal mask, and
-        # there it is static per ring step (see the step loop): the Pallas
-        # kernel therefore never needs a device-varying offset.
+    def attend(kv_pair, *, causal, k_offset):
         if use_flash:
             return flash_attention(
-                q_blk,
-                kv_pair[0],
-                kv_pair[1],
-                causal=causal,
-                k_offset=k_offset,
-                block_q=block,
-                block_k=block,
-                return_partials=True,
+                q_blk, kv_pair[0], kv_pair[1], causal=causal, k_offset=k_offset,
+                block_q=block, block_k=block, return_partials=True,
             )
         return attend_block(
             q_blk, kv_pair[0], kv_pair[1], causal=causal, k_offset=k_offset
@@ -154,17 +223,7 @@ def _ring_shard(
     partial_out = None
     kv = (k_blk, v_blk)
     for step in range(n):
-        # Launch the rotation for the NEXT step before computing on the
-        # current shard: XLA overlaps the ICI permute with the attend matmuls.
         kv_next = jax.lax.ppermute(kv, axis_name, perm) if step < n - 1 else None
-
-        # At step t this device holds the KV shard of src = (rank - t) % n.
-        # Under the causal mask only the src/rank ORDER matters, and it is
-        # static given the step: step 0 is our own shard (the causal
-        # diagonal, offset 0); for step > 0 the shard is either strictly in
-        # our past (src < rank: every key visible, no mask needed) or
-        # strictly in our future (src > rank: fully masked, skip the FLOPs
-        # entirely -- half the ring work on average).
         if not causal:
             p = attend(kv, causal=False, k_offset=0)
         elif step == 0:
@@ -175,11 +234,6 @@ def _ring_shard(
                 return attend(kv_pair, causal=False, k_offset=0)
 
             def skip(kv_pair):
-                # Neutral partial: NEG_INF row-max makes combine_partials
-                # weight this contribution exp(NEG_INF - m_real) = 0.
-                # The varying zero keeps both cond branches typed as
-                # device-varying under shard_map (a plain constant would be
-                # replicated and the branch output types would disagree).
                 zero = jnp.sum(
                     kv_pair[0][..., :1, :1].astype(jnp.float32) * 0.0, axis=(-2, -1)
                 )
@@ -191,9 +245,113 @@ def _ring_shard(
                 return acc, m, l
 
             p = jax.lax.cond(rank >= step, compute, skip, kv)
-
         partial_out = p if partial_out is None else combine_partials(partial_out, p)
         if kv_next is not None:
             kv = kv_next
 
-    return finalize_partials(partial_out).astype(q_blk.dtype)
+    _, m, l = partial_out
+    out = finalize_partials(partial_out).astype(q_blk.dtype)
+    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
+    return out, lse
+
+
+def _ring_bwd_shard(
+    q_blk, k_blk, v_blk, out, lse, dout, *, axis_name, n, causal
+):
+    """Backward ring: dq accumulates locally; (dk, dv) rotate home."""
+    import math as _math  # local: keep the module surface jax-only
+
+    scale = 1.0 / _math.sqrt(q_blk.shape[-1])
+    rank = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    do32 = dout.astype(jnp.float32)
+    q32 = q_blk.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)
+
+    dq = jnp.zeros(q_blk.shape, jnp.float32)
+    kv = (k_blk, v_blk)
+    dkv = (
+        jnp.zeros(k_blk.shape, jnp.float32),
+        jnp.zeros(v_blk.shape, jnp.float32),
+    )
+    for step in range(n):
+        # At step t this device holds shard src = (rank - t) % n and ITS
+        # gradient accumulator (they rotate together, so after the loop's n
+        # rotations each accumulator is back home).
+        def compute(args):
+            kv_pair, dkv_pair, dq_in = args
+            dq_p, dk_p, dv_p = _pair_grads(
+                q32, kv_pair[0], kv_pair[1], lse, delta, do32,
+                causal=(causal and step == 0), scale=scale,
+            )
+            return (dkv_pair[0] + dk_p, dkv_pair[1] + dv_p), dq_in + dq_p
+
+        def skip(args):
+            _, dkv_pair, dq_in = args
+            return dkv_pair, dq_in
+
+        if not causal or step == 0:
+            dkv, dq = compute((kv, dkv, dq))
+        else:
+            dkv, dq = jax.lax.cond(rank >= step, compute, skip, (kv, dkv, dq))
+        kv, dkv = jax.lax.ppermute((kv, dkv), axis_name, perm)
+
+    return (
+        dq.astype(q_blk.dtype),
+        dkv[0].astype(k_blk.dtype),
+        dkv[1].astype(v_blk.dtype),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def build_ring_attention_trainable(
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    axis_name: str = DATA_AXIS,
+    use_flash: bool | None = None,
+):
+    """Differentiable ring attention over ``mesh`` (compile-once factory).
+
+    Same exactness/layout contract as build_ring_attention; gradients flow
+    with O(S_local * block) activation memory via the backward ring (module
+    comment above).  Closes ROADMAP r1's "ring attention with flash attend
+    under gradients".
+    """
+    n = mesh.shape[axis_name]
+    seq_spec = P(None, None, axis_name, None)
+    check = all(d.platform == "tpu" for d in mesh.devices.flat)
+
+    fwd_inner = shard_map(
+        functools.partial(
+            _ring_shard_with_lse, axis_name=axis_name, n=n, causal=causal,
+            use_flash=use_flash,
+        ),
+        mesh=mesh,
+        in_specs=(seq_spec,) * 3,
+        out_specs=(seq_spec, P(None, None, axis_name)),
+        check_vma=check,
+    )
+    bwd_inner = shard_map(
+        functools.partial(_ring_bwd_shard, axis_name=axis_name, n=n, causal=causal),
+        mesh=mesh,
+        in_specs=(seq_spec,) * 4 + (P(None, None, axis_name), seq_spec),
+        out_specs=(seq_spec,) * 3,
+        check_vma=check,
+    )
+
+    @jax.custom_vjp
+    def ring_trainable(q, k, v):
+        out, _ = fwd_inner(q, k, v)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = fwd_inner(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, dout):
+        q, k, v, out, lse = res
+        return bwd_inner(q, k, v, out, lse, dout)
+
+    ring_trainable.defvjp(fwd, bwd)
+    return jax.jit(ring_trainable)

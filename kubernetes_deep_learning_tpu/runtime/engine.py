@@ -103,10 +103,12 @@ class InferenceEngine:
             if mesh is not None:
                 import dataclasses
 
+                # Host-side numpy dequant: the jnp variant would briefly
+                # materialize the full f32 tree on one device at load.
                 artifact = dataclasses.replace(
                     artifact,
-                    variables=jax.device_get(
-                        quant_lib.dequantize_variables(artifact.variables)
+                    variables=quant_lib.dequantize_variables_host(
+                        artifact.variables
                     ),
                 )
         if mesh is not None:

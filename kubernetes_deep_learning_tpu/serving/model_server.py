@@ -531,6 +531,24 @@ def _serve_cross_host(args) -> int:
     (name,) = _single_model_name(args.models)
     version = art.latest_version(args.models, name)
     artifact = art.load_artifact(art.version_dir(args.models, name, version))
+    if artifact.metadata.get("quantization"):
+        # kdlt-quantize'd artifact: the shard/forward path addresses float
+        # kernel leaves, so dequantize host-side before sharding (same as
+        # InferenceEngine's mesh path).
+        from kubernetes_deep_learning_tpu.ops.quantize import (
+            SCHEME,
+            dequantize_variables_host,
+        )
+
+        if artifact.metadata["quantization"] != SCHEME:
+            raise ValueError(
+                f"unknown quantization scheme {artifact.metadata['quantization']!r}"
+            )
+        import dataclasses
+
+        artifact = dataclasses.replace(
+            artifact, variables=dequantize_variables_host(artifact.variables)
+        )
     xh = CrossHostForward(
         artifact.spec,
         mesh,

@@ -18,7 +18,7 @@ import jax
 import optax
 
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
-from kubernetes_deep_learning_tpu.parallel.mesh import batch_sharding
+from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS, batch_sharding
 from kubernetes_deep_learning_tpu.training import checkpoint as ckpt_lib
 from kubernetes_deep_learning_tpu.training.data import PrefetchIterator
 from kubernetes_deep_learning_tpu.training.trainer import (
@@ -42,14 +42,35 @@ def evaluate(
     aggregation is by per-example sums.  Pass a prebuilt ``eval_step`` when
     calling repeatedly (fit does) to avoid re-jitting.
     """
+    import numpy as np
+
     step_fn = eval_step or build_eval_step(spec, mesh=mesh, topk=topk)
     sharding = batch_sharding(mesh) if mesh is not None else None
+    n_axis = 1 if mesh is None else mesh.shape[DATA_AXIS]
     totals = {"loss_sum": 0.0, "top1_sum": 0.0, "topk_sum": 0.0, "count": 0.0}
     for images, labels in batches:
+        n = labels.shape[0]
+        valid = None
         if sharding is not None:
+            # Tail batches must divide the data axis: pad, and mask the
+            # padding out of every sum via the step's valid vector.
+            pad = (-n) % n_axis
+            if pad:
+                images = np.concatenate(
+                    [images, np.zeros((pad, *images.shape[1:]), images.dtype)]
+                )
+                labels = np.concatenate(
+                    [labels, np.zeros((pad,), labels.dtype)]
+                )
+            valid = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+            )
             images = jax.device_put(images, sharding)
             labels = jax.device_put(labels, sharding)
-        m = step_fn(state, images, labels)
+            valid = jax.device_put(valid, sharding)
+        m = step_fn(state, images, labels) if valid is None else step_fn(
+            state, images, labels, valid
+        )
         for key in totals:
             totals[key] += float(m[key])
     n = max(totals["count"], 1.0)

@@ -160,24 +160,33 @@ def build_eval_step(
     model = create_model(spec, dtype=dtype)
     k = min(topk, spec.num_classes)
 
-    def eval_step(state: TrainState, images, labels):
+    def eval_step(state: TrainState, images, labels, valid=None):
+        # ``valid`` (f32 (N,) of 0/1) masks padding rows: mesh serving pads
+        # tail batches up to the data-axis size (loop.evaluate), and padded
+        # rows must not count toward any sum.
         x = normalize(images, spec.preprocessing)
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             x,
             train=False,
         )
+        v = jnp.ones(labels.shape[0], jnp.float32) if valid is None else valid
         losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-        top1 = logits.argmax(-1) == labels
-        in_topk = (jax.lax.top_k(logits, k)[1] == labels[:, None]).any(-1)
+        top1 = (logits.argmax(-1) == labels).astype(jnp.float32)
+        in_topk = (
+            (jax.lax.top_k(logits, k)[1] == labels[:, None]).any(-1)
+        ).astype(jnp.float32)
         return {
-            "loss_sum": losses.sum(),
-            "top1_sum": top1.sum(),
-            "topk_sum": in_topk.sum(),
-            "count": jnp.asarray(labels.shape[0], jnp.int32),
+            "loss_sum": (losses * v).sum(),
+            "top1_sum": (top1 * v).sum(),
+            "topk_sum": (in_topk * v).sum(),
+            "count": v.sum(),
         }
 
     if mesh is None:
         return jax.jit(eval_step)
     batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
-    return jax.jit(eval_step, in_shardings=(None, batch_sharding, batch_sharding))
+    return jax.jit(
+        eval_step,
+        in_shardings=(None, batch_sharding, batch_sharding, batch_sharding),
+    )
