@@ -29,11 +29,14 @@ WORKDIR /app
 COPY pyproject.toml constraints.txt ./
 COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
 # constraints.txt pins exact versions (the reference's Pipfile.lock role).
-RUN pip install --no-cache-dir -c constraints.txt .
+RUN pip install --no-cache-dir -c constraints.txt ".[grpc]"
 
 # Versioned artifact layout /models/<name>/<version>/ -- the same convention
 # the reference bakes its SavedModel with (tf-serving.dockerfile:5).
 COPY models /models
 
-EXPOSE 8500
-ENTRYPOINT ["kdlt-model-server", "--models", "/models", "--port", "8500"]
+# 8500 = msgpack/JSON HTTP (probes, gateway); 8501 = the reference's
+# exact gRPC PredictionService wire (serving/grpc_predict.py) so
+# TF-Serving-era clients work against this tier unmodified.
+EXPOSE 8500 8501
+ENTRYPOINT ["kdlt-model-server", "--models", "/models", "--port", "8500", "--grpc-port", "8501"]

@@ -366,6 +366,27 @@ def _attn_fwd(q, k, v, causal: bool):
     return out, (q, k, v, out, lse)
 
 
+def block_grads(q32, k32, v32, lse_q, delta_q, do32_q, scale, mask=None):
+    """One (q-block, kv-block) backward pair from the saved logsumexp.
+
+    THE single implementation of the FlashAttention-2 recomputation body --
+    shared by the non-causal scan, the 2D-tiled causal backward, and the
+    trainable ring's per-shard gradients (parallel.ring), so the score/p/ds
+    algebra can never drift between them.  All inputs f32; ``mask`` is an
+    optional (sq_blk, sk_blk) bool visibility mask.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse_q[..., None])
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32_q)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32_q, v32)
+    ds = p * (dp - delta_q[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+    return dq, dk, dv
+
+
 def _attn_bwd(causal: bool, res, dout):
     q, k, v, out, lse = res
     b, h, sq, d = q.shape
@@ -385,18 +406,14 @@ def _attn_bwd(causal: bool, res, dout):
     # Bidirectional: every (q, kv) pair contributes, so there is nothing to
     # skip and the single-level KV scan has the least loop overhead.
     def body(dq_acc, j):
-        k_j = jax.lax.dynamic_slice_in_dim(k, j * block, block, axis=2)
-        v_j = jax.lax.dynamic_slice_in_dim(v, j * block, block, axis=2)
-        k32 = k_j.astype(jnp.float32)
-        v32 = v_j.astype(jnp.float32)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
-        p = jnp.exp(s - lse[..., None])          # (B,H,Sq,block), recomputed
-        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
-        ds = p * (dp - delta[..., None])
-        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
-        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
-        return dq_acc, (dk_j, dv_j)
+        k32 = jax.lax.dynamic_slice_in_dim(k, j * block, block, axis=2).astype(
+            jnp.float32
+        )
+        v32 = jax.lax.dynamic_slice_in_dim(v, j * block, block, axis=2).astype(
+            jnp.float32
+        )
+        dq_j, dk_j, dv_j = block_grads(q32, k32, v32, lse, delta, do32, scale)
+        return dq_acc + dq_j, (dk_j, dv_j)
 
     dq, (dks, dvs) = jax.lax.scan(
         body, jnp.zeros(q.shape, jnp.float32), jnp.arange(nk)
@@ -435,7 +452,6 @@ def _attn_bwd_2d(q32, k, v, do32, lse, delta, scale, block, q_dtype):
 
             def compute(args):
                 dq_full, dk_acc, dv_acc = args
-                s = jnp.einsum("bhqd,bhkd->bhqk", q_i, k32) * scale
                 rows = (
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block), 0)
                     + i * block_q
@@ -444,12 +460,9 @@ def _attn_bwd_2d(q32, k, v, do32, lse, delta, scale, block, q_dtype):
                     jax.lax.broadcasted_iota(jnp.int32, (block_q, block), 1)
                     + j * block
                 )
-                s = jnp.where(rows >= cols, s, NEG_INF)
-                p = jnp.exp(s - lse_i[..., None])
-                dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_i)
-                dp = jnp.einsum("bhqd,bhkd->bhqk", do_i, v32)
-                ds = p * (dp - dl_i[..., None])
-                dq_i = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+                dq_i, dk_i, dv_i = block_grads(
+                    q_i, k32, v32, lse_i, dl_i, do_i, scale, mask=rows >= cols
+                )
                 dq_full = jax.lax.dynamic_update_slice_in_dim(
                     dq_full,
                     jax.lax.dynamic_slice_in_dim(
@@ -459,8 +472,7 @@ def _attn_bwd_2d(q32, k, v, do32, lse, delta, scale, block, q_dtype):
                     i * block_q,
                     axis=2,
                 )
-                dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_i) * scale
-                return dq_full, dk_acc, dv_acc
+                return dq_full, dk_acc + dk_i, dv_acc + dv_i
 
             # Skip pairs strictly above the diagonal: the last row of q
             # block i is i*bq + bq - 1; it sees no key >= that + 1.

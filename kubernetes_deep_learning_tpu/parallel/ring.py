@@ -154,6 +154,8 @@ def _pair_grads(q32, k_j, v_j, lse, delta, do32, *, causal: bool, scale: float):
     O(S_local * block), not O(S_local^2).  causal=True means this is the
     DIAGONAL pair (same shard: lower-triangular mask at offset 0).
     """
+    from kubernetes_deep_learning_tpu.ops.attention import block_grads
+
     sk = k_j.shape[2]
     block = _flash_block(sk) or sk
     nk = sk // block
@@ -166,19 +168,16 @@ def _pair_grads(q32, k_j, v_j, lse, delta, do32, *, causal: bool, scale: float):
         v_b = jax.lax.dynamic_slice_in_dim(v_j, j * block, block, axis=2).astype(
             jnp.float32
         )
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_b) * scale
+        mask = None
         if causal:
             # j * block is traced (scan counter); the iota mask handles it.
             rows = jax.lax.broadcasted_iota(jnp.int32, (sq, block), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (sq, block), 1) + j * block
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])
-        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v_b)
-        ds = p * (dp - delta[..., None])
-        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, k_b) * scale
-        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
-        return dq_acc, (dk_b, dv_b)
+            mask = rows >= cols
+        dq_b, dk_b, dv_b = block_grads(
+            q32, k_b, v_b, lse, delta, do32, scale, mask=mask
+        )
+        return dq_acc + dq_b, (dk_b, dv_b)
 
     dq, (dks, dvs) = jax.lax.scan(
         body, jnp.zeros(q32.shape, jnp.float32), jnp.arange(nk)
@@ -249,10 +248,12 @@ def _ring_shard_with_lse(
         if kv_next is not None:
             kv = kv_next
 
-    _, m, l = partial_out
-    out = finalize_partials(partial_out).astype(q_blk.dtype)
-    lse = m + jnp.log(jnp.where(l == 0.0, 1.0, l))
-    return out, lse
+    # Shared epilogue with attention_trainable: the saved lse must follow
+    # the exact convention the attention backward assumes (incl. the l==0
+    # fully-masked-row guard).
+    from kubernetes_deep_learning_tpu.ops.attention import _finalize_with_lse
+
+    return _finalize_with_lse(partial_out, q_blk.dtype)
 
 
 def _ring_bwd_shard(
@@ -276,8 +277,13 @@ def _ring_bwd_shard(
     )
     for step in range(n):
         # At step t this device holds shard src = (rank - t) % n and ITS
-        # gradient accumulator (they rotate together, so after the loop's n
-        # rotations each accumulator is back home).
+        # gradient accumulator.  The kv rotation launches BEFORE the
+        # compute (same overlap trick as the forward) and skips the useless
+        # final hop; dkv must rotate AFTER the compute (this step's grads
+        # go into it first) and does need the final hop -- n total
+        # rotations land each accumulator back on its shard's owner.
+        kv_next = jax.lax.ppermute(kv, axis_name, perm) if step < n - 1 else None
+
         def compute(args):
             kv_pair, dkv_pair, dq_in = args
             dq_p, dk_p, dv_p = _pair_grads(
@@ -294,7 +300,9 @@ def _ring_bwd_shard(
             dkv, dq = compute((kv, dkv, dq))
         else:
             dkv, dq = jax.lax.cond(rank >= step, compute, skip, (kv, dkv, dq))
-        kv, dkv = jax.lax.ppermute((kv, dkv), axis_name, perm)
+        dkv = jax.lax.ppermute(dkv, axis_name, perm)
+        if kv_next is not None:
+            kv = kv_next
 
     return (
         dq.astype(q_blk.dtype),

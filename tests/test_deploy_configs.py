@@ -1,0 +1,107 @@
+"""Deploy-config consistency: the checks a docker build would catch.
+
+This environment has no container runtime (ROADMAP "Operations"), so the
+images cannot be built here; these tests pin everything statically
+verifiable instead: dockerfile COPY sources exist, entrypoints name real
+console scripts, the k8s manifests wire the ports and env vars the code
+actually listens on, and the two tiers' service DNS names line up --
+the class of mistakes the reference's guide debugs by kubectl-eye
+(reference guide.md:461-581).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(REPO, "deploy")
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def _yaml_docs(path):
+    return [d for d in yaml.safe_load_all(_read(path)) if d]
+
+
+def test_dockerfile_copy_sources_exist():
+    for name in ("gateway.dockerfile", "model-server.dockerfile"):
+        text = _read(os.path.join(DEPLOY, name))
+        for m in re.finditer(r"^\s*COPY\s+(?:--[\w=]+\s+)*(\S+)\s+\S+", text, re.M):
+            src = m.group(1)
+            if src == "models":
+                # Build-time artifact: kdlt-export produces it right before
+                # docker build, the same way the reference bakes its
+                # SavedModel (reference tf-serving.dockerfile:5).
+                continue
+            assert os.path.exists(os.path.join(REPO, src)), (
+                f"{name}: COPY source {src!r} does not exist in the build context"
+            )
+
+
+def test_dockerfile_entrypoints_are_real_console_scripts():
+    # tomllib is stdlib only on 3.11+; requires-python allows 3.10.
+    tomllib = pytest.importorskip("tomllib")
+
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        scripts = set(tomllib.load(f)["project"]["scripts"])
+    for name in ("gateway.dockerfile", "model-server.dockerfile"):
+        text = _read(os.path.join(DEPLOY, name))
+        used = set(re.findall(r"kdlt-[\w-]+", text))
+        missing = {u for u in used if u not in scripts and not u.startswith("kdlt-models")}
+        assert not missing, f"{name} invokes unknown scripts {missing}"
+
+
+def test_k8s_ports_and_env_wiring():
+    from kubernetes_deep_learning_tpu.serving.gateway import (
+        DEFAULT_PORT as GATEWAY_PORT,
+        SERVING_HOST_ENV,
+    )
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        DEFAULT_PORT as MODEL_PORT,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (model_svc,) = _yaml_docs(os.path.join(k8s, "model-server-service.yaml"))
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    (gw_svc,) = _yaml_docs(os.path.join(k8s, "gateway-service.yaml"))
+
+    model_container = model_dep["spec"]["template"]["spec"]["containers"][0]
+    assert any(
+        p["containerPort"] == MODEL_PORT for p in model_container["ports"]
+    ), "model-server container must expose its default port"
+    assert model_svc["spec"]["ports"][0]["port"] == MODEL_PORT
+
+    gw_container = gw_dep["spec"]["template"]["spec"]["containers"][0]
+    assert any(p["containerPort"] == GATEWAY_PORT for p in gw_container["ports"])
+    env = {e["name"]: e.get("value", "") for e in gw_container.get("env", [])}
+    assert SERVING_HOST_ENV in env, (
+        f"gateway Deployment must set {SERVING_HOST_ENV} (the reference's "
+        "TF_SERVING_HOST convention)"
+    )
+    # The discovery value must point at the model Service's DNS name + port.
+    svc_name = model_svc["metadata"]["name"]
+    assert env[SERVING_HOST_ENV].startswith(svc_name), env[SERVING_HOST_ENV]
+    assert env[SERVING_HOST_ENV].endswith(str(MODEL_PORT))
+    # LoadBalancer ingress fronts the gateway (reference serving-gateway-service.yaml:8-11)
+    assert gw_svc["spec"]["type"] == "LoadBalancer"
+    assert gw_svc["spec"]["ports"][0]["targetPort"] == GATEWAY_PORT
+
+
+def test_compose_services_reference_built_dockerfiles():
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    for svc in compose["services"].values():
+        build = svc.get("build")
+        if isinstance(build, dict) and "dockerfile" in build:
+            # Compose resolves context relative to the compose FILE, and the
+            # dockerfile relative to that context.
+            ctx = os.path.normpath(os.path.join(DEPLOY, build.get("context", ".")))
+            path = os.path.join(ctx, build["dockerfile"])
+            assert os.path.exists(path), f"compose references missing {path}"
