@@ -67,6 +67,31 @@ def has_fast_forward(spec: ModelSpec) -> bool:
     return spec.family == "xception"
 
 
+def resolve_fast(
+    spec: ModelSpec, dtype: Any, fast: bool | str, backend: str | None = None
+) -> bool:
+    """The fast-flag resolution build_forward applies, exposed so callers
+    (the serving engine's compile-failure fallback) can know ahead of time
+    whether the fused Pallas path will be in the traced program.
+
+    ``backend`` defaults to jax.default_backend(); the serving engine passes
+    its actual device's platform instead, so an engine pinned to a non-TPU
+    device on a TPU-backend host resolves "auto" to the graph that can
+    actually compile there.
+    """
+    if fast == "auto":
+        if backend is None:
+            import jax
+
+            backend = jax.default_backend()
+        return (
+            has_fast_forward(spec)
+            and jnp.dtype(dtype) == jnp.bfloat16
+            and backend == "tpu"
+        )
+    return bool(fast) and has_fast_forward(spec)
+
+
 def build_forward(
     spec: ModelSpec, dtype: Any = jnp.bfloat16, fast: bool | str = "auto"
 ) -> Callable[[Any, Any], Any]:
@@ -84,15 +109,7 @@ def build_forward(
     keeps the flax graph (exact parity; the exporter uses this so artifacts
     stay portable across platforms).
     """
-    import jax
-
-    if fast == "auto":
-        fast = (
-            has_fast_forward(spec)
-            and jnp.dtype(dtype) == jnp.bfloat16
-            and jax.default_backend() == "tpu"
-        )
-    if fast and has_fast_forward(spec):
+    if resolve_fast(spec, dtype, fast):
         from kubernetes_deep_learning_tpu.models.xception_fast import (
             build_fast_forward,
         )

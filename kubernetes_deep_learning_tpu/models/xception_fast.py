@@ -111,6 +111,17 @@ def build_fast_forward(
         # exit flow's pool/residual are layout-agnostic XLA ops, so the
         # transpose back never happens -- the head mean reduces over the
         # leading spatial axes directly.
+        #
+        # Batch rides the sublane axis in this layout, and the kernels'
+        # (H, W, bt) -> rows collapse is only Mosaic-legal when the batch
+        # tile is 8-aligned (BENCH_r02's batch-1 compile failure).  Pad the
+        # batch ONCE here to a multiple of 8 and slice after the head mean,
+        # so the per-kernel padding in ops.fused_sepconv stays a no-op and
+        # small serving buckets (1, 2, 4) compile the same fused program.
+        batch = x.shape[0]
+        pad_rows = (-batch) % 8
+        if pad_rows:
+            x = jnp.pad(x, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
         xt = x.transpose(1, 2, 0, 3)
         for idx in _MIDDLE_BLOCKS:
             dw, pw, scale, shift = middle_block_weights(p, s, f"block{idx}")
@@ -160,7 +171,7 @@ def build_fast_forward(
         )
 
         # --- head (ClassifierHead semantics; spatial = leading axes) ---
-        x = xt.mean(axis=(0, 1))
+        x = xt.mean(axis=(0, 1))[:batch]
         head = p["head"]
         i = 0
         while f"hidden_{i}" in head:

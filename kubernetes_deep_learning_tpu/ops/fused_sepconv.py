@@ -84,15 +84,19 @@ def middle_block_weights(params: dict, stats: dict, block: str):
 
 def pick_batch_tile(batch: int, h: int, w: int, c: int, budget_bytes: int = 9 << 20) -> int:
     """Largest bt in {16, 8} whose bf16 tile fits the budget (bt=16 at the
-    Xception middle shape measured fastest); 8 when only that divides; whole
-    batch otherwise (Mosaic requires the sublane block divisible by 8 OR
-    equal to the array dim)."""
+    Xception middle shape measured fastest); 8 otherwise.
+
+    Only 8-multiples are ever returned: the kernel collapses (H, W, bt) into
+    MXU rows, and Mosaic rejects that reshape unless the sublane-adjacent
+    dim is 8-aligned (BENCH_r02: ``(361,728)->(19,19,1,728)`` at bt=1 failed
+    to compile).  Callers with ``batch % 8 != 0`` must pad the batch axis up
+    to a multiple of 8 first -- ``fused_sepconv_block_t`` and
+    ``fused_sepconv_chain_t`` do this internally.
+    """
     for bt in (16, 8):
         if batch % bt == 0 and h * w * bt * c * 2 <= budget_bytes:
             return bt
-    if batch % 8 == 0:
-        return 8
-    return batch
+    return 8
 
 
 def sepconv_block_reference(x, dw, pw, scale, shift):
@@ -120,21 +124,49 @@ def sepconv_block_reference(x, dw, pw, scale, shift):
     return x + y
 
 
+def _pad_batch_to_8(xt):
+    """Pad the (H, W, B, C) batch axis up to a multiple of 8 (min 8).
+
+    The kernels collapse (H, W, bt) rows for the MXU; Mosaic only accepts
+    that reshape when bt is 8-aligned, so any other batch is served by
+    padding the sublane axis with zeros and slicing the result.  Returns
+    (padded, original_B).  At small batches the waste is latency-trivial:
+    the middle-flow tile is weight-bandwidth-bound, not row-bound.
+    """
+    import jax.numpy as jnp
+
+    B = xt.shape[2]
+    pad = (-B) % 8
+    if pad:
+        xt = jnp.pad(xt, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    return xt, B
+
+
+def _legal_bt(bt: int, B: int) -> int:
+    """Clamp a (possibly caller-supplied) batch tile to a Mosaic-legal one:
+    a multiple of 8 that divides the (already 8-aligned) padded batch."""
+    bt = min(-(-bt // 8) * 8, B)
+    while B % bt:
+        bt -= 8
+    return bt
+
+
 def fused_sepconv_block_t(xt, dw, pw, scale, shift, *, bt: int = 0, interpret: bool = False):
     """The kernel, on (H, W, B, C) bf16 input; returns the same layout.
 
     Chain middle blocks in this transposed layout and pay the NHWC
     transpose once per flow (see models.xception_fast).  ``bt`` 0 = auto.
+    Any batch size is legal: non-8-aligned batches are zero-padded on the
+    sublane axis around the kernel (see _pad_batch_to_8).
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    xt, B_orig = _pad_batch_to_8(xt)
     H, W, B, C = xt.shape
-    bt = bt or pick_batch_tile(B, H, W, C)
-    bt = min(bt, B)
-    assert B % bt == 0, (B, bt)
+    bt = _legal_bt(bt or pick_batch_tile(B, H, W, C), B)
 
     def kernel(x_ref, dw_ref, pw_ref, s_ref, b_ref, o_ref):
         y = x_ref[...]  # (H, W, bt, C) bf16
@@ -157,7 +189,7 @@ def fused_sepconv_block_t(xt, dw, pw, scale, shift, *, bt: int = 0, interpret: b
             y = (z * s_ref[i] + b_ref[i]).astype(jnp.bfloat16).reshape(H, W, bt, C)
         o_ref[...] = x_ref[...] + y
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=(B // bt,),
         in_specs=[
@@ -172,6 +204,7 @@ def fused_sepconv_block_t(xt, dw, pw, scale, shift, *, bt: int = 0, interpret: b
         compiler_params=_compiler_params(),
         interpret=interpret,
     )(xt, dw, pw, scale, shift)
+    return out if B_orig == B else out[:, :, :B_orig, :]
 
 
 @functools.cache
@@ -216,10 +249,11 @@ def fused_sepconv_chain_t(
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    xt, B_orig = _pad_batch_to_8(xt)
     H, W, B, C0 = xt.shape
-    bt = bt or pick_batch_tile(B, H, W, max(s["pw"].shape[1] for s in stages))
-    bt = min(bt, B)
-    assert B % bt == 0, (B, bt)
+    bt = _legal_bt(
+        bt or pick_batch_tile(B, H, W, max(s["pw"].shape[1] for s in stages)), B
+    )
     c_out_final = stages[-1]["pw"].shape[1]
     pre = tuple(bool(s["pre_relu"]) for s in stages)
     post = tuple(bool(s["post_relu"]) for s in stages)
@@ -264,7 +298,7 @@ def fused_sepconv_chain_t(
         ]
         args += [s["dw"], s["pw"], s["scale"], s["shift"]]
 
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid=(B // bt,),
         in_specs=in_specs,
@@ -273,6 +307,7 @@ def fused_sepconv_chain_t(
         compiler_params=_compiler_params(),
         interpret=interpret,
     )(*args)
+    return out if B_orig == B else out[:, :, :B_orig, :]
 
 
 def sepconv_stage_weights(params: dict, stats: dict, sep_name: str, bn_name: str,

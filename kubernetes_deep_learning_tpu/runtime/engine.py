@@ -76,6 +76,10 @@ class InferenceEngine:
         self._device = device or jax.local_devices()[0]
         self._lock = threading.Lock()
         self._ready = threading.Event()
+        # Set by warmup() when a fused-fast-path compile failure forced the
+        # engine back onto the exact flax graph (see _degrade_fast).
+        self.fast_degraded = False
+        self._fast_engaged = False
 
         from kubernetes_deep_learning_tpu.models import build_forward
 
@@ -152,18 +156,21 @@ class InferenceEngine:
         # live-jit forward even when the artifact carries StableHLO: same
         # variables, measurably faster program (models.xception_fast).  The
         # exported module remains the portable format and the path for
-        # families with no in-tree model.
-        from kubernetes_deep_learning_tpu.models import has_fast_forward
+        # families with no in-tree model.  Resolution is keyed to THIS
+        # engine's device platform, not the process default backend, so an
+        # engine pinned off-TPU never traces a program it cannot compile.
+        import jax.numpy as jnp
 
-        prefer_live = (
-            platform == "tpu"
-            and has_fast_forward(self.spec)
-            # Same conditions build_forward's fast="auto" applies: without
-            # them, skipping the exported module would only buy a slower
-            # live re-trace of the flax graph.
-            and self._compute_dtype == "bfloat16"
-            and self._fast != False  # noqa: E712 - "auto" must stay truthy
+        from kubernetes_deep_learning_tpu.models import resolve_fast
+
+        # Whether the fused path can compile on THIS device at all ("auto"
+        # semantics, device-keyed).  The exported-module bypass keys off
+        # viability -- an explicit fast=True must not skip a present exported
+        # module on a device where the fused program is guaranteed to fail.
+        fast_viable = resolve_fast(
+            self.spec, jnp.dtype(self._compute_dtype), "auto", backend=platform
         )
+        prefer_live = fast_viable and self._fast != False  # noqa: E712 - "auto" is truthy
         if (
             use_exported
             and not prefer_live
@@ -176,15 +183,24 @@ class InferenceEngine:
             # JSON debug path) runs through the in-tree forward instead,
             # built lazily: a StableHLO artifact stays servable even when its
             # spec.family has no in-tree model, and the (slow) build/compile
-            # is deferred to first debug use.
+            # is deferred to first debug use.  _fast is concretized so that
+            # lazy build also never traces a fused program this device
+            # cannot compile (prefer_live is False on every path here).
+            self._fast = prefer_live
             self._jitted_f32 = None
         else:
             # build_forward branches on input dtype at trace time and jit
             # specializes per dtype, so one jitted fn serves both paths.
-            import jax.numpy as jnp
-
-            self._jitted = jax.jit(self._live_forward(jnp.dtype(self._compute_dtype)))
-            self._jitted_f32 = self._jitted
+            # _fast becomes a concrete bool here: build_forward must not
+            # re-resolve "auto" against the default backend when this
+            # engine's device decided otherwise.  An explicit fast=True is
+            # honored even where non-viable (tests force the failure path;
+            # warmup degrades it with a loud log).
+            self._fast = resolve_fast(
+                self.spec, jnp.dtype(self._compute_dtype), self._fast, backend=platform
+            )
+            self._fast_engaged = self._fast
+            self._build_live_jit()
         # The f32 debug path dispatches under its own lock: its lazy first
         # compile (tens of seconds on TPU) must never stall warm uint8
         # traffic serialized on _lock.  Concurrent dispatch of two programs
@@ -206,6 +222,10 @@ class InferenceEngine:
             "kdlt_engine_pad_images_total", "padding rows executed (bucket waste)"
         )
         self._m_warmup = registry.gauge("kdlt_engine_warmup_seconds", "total warmup compile time")
+        self._m_fast_degraded = registry.gauge(
+            "kdlt_engine_fast_degraded",
+            "1 when a fused fast-path compile failure forced the exact graph",
+        )
 
     @property
     def ready(self) -> bool:
@@ -217,15 +237,70 @@ class InferenceEngine:
         The reference has no readiness probes, so a cold TF-Serving pod can
         receive traffic before the model loads (SURVEY.md section 5); here
         k8s readiness is wired to this warmup being done.
+
+        If a bucket fails to compile on the fused fast path (a Mosaic
+        legality regression at some shape), the engine degrades to the exact
+        flax graph and re-warms every bucket rather than killing the model
+        (round-2's failure mode: the default TPU config could not boot).
         """
         t0 = time.perf_counter()
-        for b in self.buckets:
+        pending = list(self.buckets)
+        retried = False
+        while pending:
+            b = pending[0]
             x = np.zeros((b, *self.spec.input_shape), np.uint8)
-            np.asarray(self._jitted(self._variables, x))  # block until compiled+run
+            try:
+                np.asarray(self._jitted(self._variables, x))  # block: compile+run
+            except Exception as exc:  # noqa: BLE001 - compile errors vary by backend
+                # One retry first: a deterministic Mosaic/lowering failure
+                # fails again immediately, but a transient runtime error
+                # (device busy, brief HBM pressure from a neighbor) must not
+                # lock a healthy pod onto the slower exact graph for life.
+                if not retried:
+                    retried = True
+                    continue
+                if not self._degrade_fast(b, exc):
+                    raise
+                pending = list(self.buckets)  # re-warm all on the exact graph
+                retried = False  # the exact graph gets its own retry budget
+                continue
+            pending.pop(0)
+            retried = False
         dt = time.perf_counter() - t0
         self._m_warmup.set(dt)
         self._ready.set()
         return dt
+
+    def _degrade_fast(self, bucket: int, exc: Exception) -> bool:
+        """Swap the live-jit forward to the exact flax graph after a fast-path
+        compile failure; returns False when there is nothing to degrade to
+        (mesh/exported/already-exact engines re-raise)."""
+        if self.mesh is not None or not self._fast_engaged:
+            return False
+        import logging
+
+        logging.getLogger(__name__).error(
+            "fused fast-path compile FAILED at bucket %d; serving the exact "
+            "flax graph instead (fast=False). Cause: %s", bucket, exc,
+        )
+        self._fast = False
+        self._fast_engaged = False
+        self.fast_degraded = True
+        # Surface on /metrics: a silently-degraded pod serves ~20% slower for
+        # its lifetime, which operators must be able to alert on.
+        self._m_fast_degraded.set(1.0)
+        self._build_live_jit()
+        return True
+
+    def _build_live_jit(self) -> None:
+        """(Re)build the live-jit forward pair; __init__ and _degrade_fast
+        must construct it identically or a degraded engine would run a
+        differently-configured program."""
+        import jax
+        import jax.numpy as jnp
+
+        self._jitted = jax.jit(self._live_forward(jnp.dtype(self._compute_dtype)))
+        self._jitted_f32 = self._jitted
 
     def _live_forward(self, dtype):
         """The live-jit forward, with inline dequantization when the

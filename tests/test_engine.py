@@ -76,3 +76,40 @@ def test_metrics_populated(engine):
     text = eng.registry.render()
     assert "kdlt_engine_images_total" in text
     assert "kdlt_engine_infer_seconds" in text
+
+
+def test_fast_compile_failure_degrades_to_exact_graph(engine):
+    """Round-2 P0 regression: a Mosaic compile failure on the fused fast
+    path must degrade the engine to the flax graph, not kill the model.
+
+    fast=True on the CPU backend is a REAL reproduction, not a mock: the
+    Pallas TPU kernel cannot lower for CPU outside interpret mode, so the
+    first warmup bucket raises at compile exactly like BENCH_r02's batch-1
+    Mosaic rejection did on TPU.
+    """
+    _, variables, spec = engine
+    import jax
+
+    if jax.default_backend() != "cpu":  # conftest forces cpu; belt and braces
+        pytest.skip("reproduction requires a backend where Pallas cannot lower")
+
+    from kubernetes_deep_learning_tpu.export import export_model, load_artifact
+    from kubernetes_deep_learning_tpu.export.artifact import version_dir
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as root:
+        export_model(spec, variables, root, dtype=np.float32)
+        artifact = load_artifact(version_dir(root, spec.name, 1))
+        eng = InferenceEngine(
+            artifact, buckets=(1, 2), use_exported=False, fast=True
+        )
+        assert eng._fast_engaged
+        dt = eng.warmup()
+        assert eng.ready and dt >= 0
+        assert eng.fast_degraded
+        assert not eng._fast_engaged
+        # and it actually serves, matching the exact graph
+        x = np.zeros((2, *spec.input_shape), np.uint8)
+        got = eng.predict(x)
+        want = np.asarray(jax.jit(build_forward(spec, dtype=None))(variables, x))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
