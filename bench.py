@@ -138,6 +138,14 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
     results = {}
     flops_img = None
     for b in batch_sizes:
+        # Auto-size the iteration count so the dev tunnel's ~70 ms dispatch
+        # RTT is amortized to <1% of each timed call: at the old fixed 30
+        # iterations it added ~2.3 ms/iteration to BOTH methods (round-3
+        # finding: the device stream was packed -- trace span 13.8 ms/iter
+        # at batch 64 -- while the bench reported 16.6).  Production PCIe
+        # dispatch is tens of us, so the RTT is a harness artifact, not
+        # serving cost; the two-method agreement check still applies.
+        k = scan_len or max(24, min(200, 25000 // b))
         x = jax.device_put(
             rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
         )
@@ -151,13 +159,13 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
 
         # Method 1: data-dependent chained scan.
         t0 = time.perf_counter()
-        float(chained(variables, x, scan_len))  # compile + first run
+        float(chained(variables, x, k))  # compile + first run
         compile_s = time.perf_counter() - t0
         per_step = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            float(chained(variables, x, scan_len))
-            per_step.append((time.perf_counter() - t0) / scan_len)
+            float(chained(variables, x, k))
+            per_step.append((time.perf_counter() - t0) / k)
         per_step = np.array(per_step)
         scan_p50_ms = float(np.percentile(per_step, 50) * 1e3)
         scan_img_s = b / float(np.median(per_step))
@@ -169,9 +177,9 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
         pipe_times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            outs = [fwd_jit(variables, x) for _ in range(scan_len)]
+            outs = [fwd_jit(variables, x) for _ in range(k)]
             jax.block_until_ready(outs)
-            pipe_times.append((time.perf_counter() - t0) / scan_len)
+            pipe_times.append((time.perf_counter() - t0) / k)
         pipe_p50_ms = float(np.percentile(pipe_times, 50) * 1e3)
         pipe_img_s = b / float(np.median(pipe_times))
 
@@ -477,7 +485,8 @@ def main() -> int:
     # 1..128 is BASELINE.json's sweep; 48/56 bracket the p50<=15ms latency
     # bound on v5e; 256/1024 probe the unbound throughput ceiling.
     p.add_argument("--batches", default="1,2,4,8,16,32,48,56,64,128,256,1024")
-    p.add_argument("--scan-len", type=int, default=30, help="fwd passes per timed call")
+    p.add_argument("--scan-len", type=int, default=0,
+                   help="fwd passes per timed call (0 = auto-size per batch to amortize dispatch RTT)")
     p.add_argument("--reps", type=int, default=5, help="timed calls per batch size")
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument(

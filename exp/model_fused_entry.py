@@ -1,0 +1,81 @@
+"""In-model A/B: full Xception forward with/without the fused entry kernel.
+
+Standalone segment timings inflate ~3x vs in-model (round-2 lesson), so the
+only verdict that counts is the full forward, anti-LICM chained scan,
+cross-checked with pipelined dispatch, at serving-relevant batches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", default="48,56,64,128,256")
+    p.add_argument("--scan-len", type=int, default=20)
+    p.add_argument("--reps", type=int, default=5)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.models.xception_fast import build_fast_forward
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    print(f"device: {dev}")
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    rng = np.random.default_rng(0)
+
+    def timed(fwd, batch):
+        x0 = jnp.asarray(
+            normalize(
+                jnp.asarray(
+                    rng.integers(0, 256, (batch, *spec.input_shape), np.uint8)
+                ),
+                spec.preprocessing,
+            )
+        )
+        x0 = jax.device_put(x0, dev)
+
+        @functools.partial(jax.jit, static_argnums=2)
+        def chained(v, xx, k):
+            def body(carry, _):
+                acc, xi = carry
+                out = fwd(v, xi)
+                s = out.sum()
+                xi = xi + (jnp.sign(s) * 1e-3).astype(xi.dtype)
+                return (acc + s.astype(jnp.float32), xi), None
+
+            (acc, _), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), xx), None, length=k
+            )
+            return acc
+
+        float(chained(variables, x0, args.scan_len))  # compile
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            float(chained(variables, x0, args.scan_len))
+            times.append((time.perf_counter() - t0) / args.scan_len)
+        return float(np.median(times))
+
+    for batch in (int(b) for b in args.batches.split(",")):
+        row = [f"batch {batch:4d}:"]
+        for name, ek in (("xla-entry", False), ("kernel-entry", True)):
+            fwd = build_fast_forward(spec, dtype=jnp.bfloat16, entry_kernel=ek)
+            ms = timed(fwd, batch) * 1e3
+            row.append(f"{name} {ms:8.3f} ms ({batch / ms * 1e3:7.1f} img/s)")
+        print("  ".join(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

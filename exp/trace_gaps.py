@@ -1,0 +1,98 @@
+"""Where does wall time go BETWEEN device ops?  Parses a jax.profiler trace
+of the forward and reports, per iteration, total span vs sum-of-op-durations
+and the largest inter-op gaps -- the 3 ms/iter unexplained by op time at
+batch 64 (round 3) is either op-boundary overhead (actionable: fewer, bigger
+ops) or a measurement artifact (not actionable)."""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import tempfile
+
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--top-gaps", type=int, default=12)
+    p.add_argument("--entry-kernel", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.models.xception_fast import build_fast_forward
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    inner = build_fast_forward(
+        spec, dtype=jnp.bfloat16, entry_kernel=args.entry_kernel
+    )
+    fwd = jax.jit(
+        lambda v, img: inner(v, normalize(img, spec.preprocessing)).astype(
+            jnp.float32
+        )
+    )
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.integers(0, 256, (args.batch, *spec.input_shape), np.uint8), dev
+    )
+    jax.block_until_ready(fwd(variables, x))
+
+    trace_dir = tempfile.mkdtemp(prefix="kdlt-gaps-")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(args.iters):
+            jax.block_until_ready(fwd(variables, x))
+
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+
+    events = trace["traceEvents"]
+    names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            names[e["pid"]] = e["args"].get("name", "")
+    dev_pids = [pid for pid, n in names.items() if "TPU" in n or "/device" in n.lower()]
+    ops = [
+        e
+        for e in events
+        if e.get("ph") == "X" and e.get("pid") in dev_pids and e.get("dur", 0) > 0
+    ]
+    print(f"device pids: { {pid: names[pid] for pid in dev_pids} }")
+    # Group by thread (device stream), sort by start.
+    by_tid: dict = {}
+    for e in ops:
+        by_tid.setdefault((e["pid"], e["tid"]), []).append(e)
+    for key, evs in sorted(by_tid.items(), key=lambda kv: -len(kv[1])):
+        evs.sort(key=lambda e: e["ts"])
+        span = evs[-1]["ts"] + evs[-1]["dur"] - evs[0]["ts"]
+        dur = sum(e["dur"] for e in evs)
+        print(
+            f"stream {key}: {len(evs)} events, span {span/1e3:.2f} ms, "
+            f"busy {dur/1e3:.2f} ms, idle {(span-dur)/1e3:.2f} ms"
+        )
+        if len(evs) < 10:
+            continue
+        gaps = []
+        for a, b in zip(evs, evs[1:]):
+            g = b["ts"] - (a["ts"] + a["dur"])
+            if g > 0:
+                gaps.append((g, a["name"][:40], b["name"][:40]))
+        gaps.sort(reverse=True)
+        for g, an, bn in gaps[: args.top_gaps]:
+            print(f"   gap {g/1e3:7.3f} ms  after {an!r} -> {bn!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,10 @@ import jax.numpy as jnp
 
 from kubernetes_deep_learning_tpu.models.layers import KERAS_BN_EPS
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
+from kubernetes_deep_learning_tpu.ops.fused_entry import (
+    entry_block_weights,
+    fused_entry_block_t,
+)
 from kubernetes_deep_learning_tpu.ops.fused_sepconv import (
     fold_bn,
     fused_sepconv_block_t,
@@ -38,12 +42,27 @@ _MIDDLE_BLOCKS = tuple(range(5, 13))
 
 
 def build_fast_forward(
-    spec: ModelSpec, dtype: Any = jnp.bfloat16, interpret: bool = False
+    spec: ModelSpec,
+    dtype: Any = jnp.bfloat16,
+    interpret: bool = False,
+    entry_kernel: bool = False,
 ) -> Callable:
     """Return ``f(variables, normalized_f32_images) -> logits (dtype)``.
 
     The caller (models.build_forward) handles uint8 normalization and the
     final f32 cast, exactly as for the flax path.
+
+    ``entry_kernel`` (EXPERIMENTAL, default off) routes conv2+block2
+    through the fused entry Pallas kernel (ops.fused_entry) and blocks 3/4
+    through the fused sepconv chains, so everything from conv1's output to
+    the head runs in the (H, W, B, C) layout.  Round-3 verdict: the kernel
+    body (4.18 ms at batch 64) beats the XLA fusions it replaces
+    (4.43 ms), but the halo-slab staging it needs costs another ~1.4 ms
+    XLA-side, so the net is a LOSS (exp/model_fused_entry.py: 21.1 vs
+    19.0 ms full-forward) -- manual DMA staging is blocked by Mosaic's
+    128-aligned-lane sliced-DMA rule at c_in=32.  Kept off the serving
+    path (models.build_forward never enables it) until the staging cost is
+    solved; blocks 3/4 chains are only reachable through this flag too.
     """
 
     def conv(x, kernel, stride=1, padding="SAME"):
@@ -85,74 +104,92 @@ def build_fast_forward(
         x, window_shape=(3, 3), strides=(2, 2), padding="SAME"
     )
 
-    def forward(variables, x):
-        p = variables["params"]
-        s = variables["batch_stats"]
-
-        # --- entry flow (flax-identical ops) ---
-        x = conv(x, p["block1_conv1"]["kernel"], stride=2, padding="VALID")
-        x = nn.relu(bn(x, p["block1_conv1_bn"], s["block1_conv1_bn"]))
-        x = conv(x, p["block1_conv2"]["kernel"], padding="VALID")
-        x = nn.relu(bn(x, p["block1_conv2_bn"], s["block1_conv2_bn"]))
-        for idx, _feat in _ENTRY_BLOCKS:
-            residual = conv(x, p[f"block{idx}_res_conv"]["kernel"], stride=2)
-            residual = bn(residual, p[f"block{idx}_res_bn"], s[f"block{idx}_res_bn"])
-            if idx > 2:
-                x = nn.relu(x)
-            x = sepconv(x, p[f"block{idx}_sepconv1"])
-            x = bn(x, p[f"block{idx}_sepconv1_bn"], s[f"block{idx}_sepconv1_bn"])
-            x = nn.relu(x)
-            x = sepconv(x, p[f"block{idx}_sepconv2"])
-            x = bn(x, p[f"block{idx}_sepconv2_bn"], s[f"block{idx}_sepconv2_bn"])
-            x = pool(x) + residual
-
-        # --- middle + exit flows: fused Pallas chains, one transpose in ---
-        # Everything from here to the head pool stays in (H, W, B, C): the
-        # exit flow's pool/residual are layout-agnostic XLA ops, so the
-        # transpose back never happens -- the head mean reduces over the
-        # leading spatial axes directly.
-        #
-        # Batch rides the sublane axis in this layout, and the kernels'
-        # (H, W, bt) -> rows collapse is only Mosaic-legal when the batch
-        # tile is 8-aligned (BENCH_r02's batch-1 compile failure).  Pad the
-        # batch ONCE here to a multiple of 8 and slice after the head mean,
-        # so the per-kernel padding in ops.fused_sepconv stays a no-op and
-        # small serving buckets (1, 2, 4) compile the same fused program.
-        batch = x.shape[0]
-        pad_rows = (-batch) % 8
-        if pad_rows:
-            x = jnp.pad(x, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
-        xt = x.transpose(1, 2, 0, 3)
-        for idx in _MIDDLE_BLOCKS:
-            dw, pw, scale, shift = middle_block_weights(p, s, f"block{idx}")
-            xt = fused_sepconv_block_t(xt, dw, pw, scale, shift, interpret=interpret)
-
-        # block13: residual 1x1/2 conv in XLA; the two sepconvs fused.
-        res_scale, res_shift = fold_bn(p["block13_res_bn"], s["block13_res_bn"])
+    def downsample_t(xt, p, s, block):
+        """Residual 1x1/2 conv (XLA einsum) + fused 2-sepconv chain +
+        max-pool + add, in the (H, W, B, C) layout -- the shared pattern of
+        blocks 3, 4, and 13 (relu -> sep -> bn, twice, then pool+res)."""
+        res_scale, res_shift = fold_bn(p[f"{block}_res_bn"], s[f"{block}_res_bn"])
         res = jnp.einsum(
             "hwbc,cd->hwbd",
             xt[::2, ::2],
-            jnp.asarray(p["block13_res_conv"]["kernel"], dtype)[0, 0],
+            jnp.asarray(p[f"{block}_res_conv"]["kernel"], dtype)[0, 0],
         )
         res = (res.astype(jnp.float32) * res_scale + res_shift).astype(dtype)
-        y13 = fused_sepconv_chain_t(
+        y = fused_sepconv_chain_t(
             xt,
             [
                 sepconv_stage_weights(
-                    p, s, "block13_sepconv1", "block13_sepconv1_bn",
+                    p, s, f"{block}_sepconv1", f"{block}_sepconv1_bn",
                     pre_relu=True, post_relu=False,
                 ),
                 sepconv_stage_weights(
-                    p, s, "block13_sepconv2", "block13_sepconv2_bn",
+                    p, s, f"{block}_sepconv2", f"{block}_sepconv2_bn",
                     pre_relu=True, post_relu=False,
                 ),
             ],
             interpret=interpret,
         )
         pooled = jax.lax.reduce_window(
-            y13, -jnp.inf, jax.lax.max, (3, 3, 1, 1), (2, 2, 1, 1), "SAME"
+            y, -jnp.inf, jax.lax.max, (3, 3, 1, 1), (2, 2, 1, 1), "SAME"
         )
-        xt = pooled + res
+        return pooled + res
+
+    def forward(variables, x):
+        p = variables["params"]
+        s = variables["batch_stats"]
+
+        # Batch rides the sublane axis in the kernels' (H, W, B, C) layout,
+        # and their (H, W, bt) -> rows collapse is only Mosaic-legal when
+        # the batch tile is 8-aligned (BENCH_r02's batch-1 compile
+        # failure).  Pad the batch ONCE to a multiple of 8 and slice after
+        # the head mean, so the per-kernel padding in ops.fused_sepconv
+        # stays a no-op and small serving buckets (1, 2, 4) compile the
+        # same fused program.
+        batch = x.shape[0]
+        pad_rows = (-batch) % 8
+
+        x = conv(x, p["block1_conv1"]["kernel"], stride=2, padding="VALID")
+        x = nn.relu(bn(x, p["block1_conv1_bn"], s["block1_conv1_bn"]))
+
+        if entry_kernel:
+            # --- transposed from conv1 out to the head: conv2+block2 in
+            # the fused entry kernel, blocks 3/4 as fused chains ---------
+            if pad_rows:
+                x = jnp.pad(x, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+            xt = x.transpose(1, 2, 0, 3).astype(jnp.bfloat16)
+            xt = fused_entry_block_t(
+                xt, entry_block_weights(p, s), interpret=interpret
+            ).astype(dtype)
+            xt = downsample_t(xt, p, s, "block3")
+            xt = downsample_t(xt, p, s, "block4")
+        else:
+            # --- entry flow on XLA fusions (flax-identical ops) ----------
+            x = conv(x, p["block1_conv2"]["kernel"], padding="VALID")
+            x = nn.relu(bn(x, p["block1_conv2_bn"], s["block1_conv2_bn"]))
+            for idx, _feat in _ENTRY_BLOCKS:
+                residual = conv(x, p[f"block{idx}_res_conv"]["kernel"], stride=2)
+                residual = bn(residual, p[f"block{idx}_res_bn"], s[f"block{idx}_res_bn"])
+                if idx > 2:
+                    x = nn.relu(x)
+                x = sepconv(x, p[f"block{idx}_sepconv1"])
+                x = bn(x, p[f"block{idx}_sepconv1_bn"], s[f"block{idx}_sepconv1_bn"])
+                x = nn.relu(x)
+                x = sepconv(x, p[f"block{idx}_sepconv2"])
+                x = bn(x, p[f"block{idx}_sepconv2_bn"], s[f"block{idx}_sepconv2_bn"])
+                x = pool(x) + residual
+            if pad_rows:
+                x = jnp.pad(x, ((0, pad_rows), (0, 0), (0, 0), (0, 0)))
+            xt = x.transpose(1, 2, 0, 3)
+
+        # --- middle + exit flows: fused Pallas chains ---------------------
+        # Everything stays in (H, W, B, C): the exit flow's pool/residual
+        # are layout-agnostic XLA ops, so the transpose back never happens
+        # -- the head mean reduces over the leading spatial axes directly.
+        for idx in _MIDDLE_BLOCKS:
+            dw, pw, scale, shift = middle_block_weights(p, s, f"block{idx}")
+            xt = fused_sepconv_block_t(xt, dw, pw, scale, shift, interpret=interpret)
+
+        xt = downsample_t(xt, p, s, "block13")
 
         # block14: two sepconvs (sep -> bn -> relu pattern), fused.
         xt = fused_sepconv_chain_t(

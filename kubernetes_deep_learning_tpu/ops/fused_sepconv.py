@@ -212,10 +212,11 @@ def _compiler_params() -> Any:
     from jax.experimental.pallas import tpu as pltpu
 
     # The default 16 MiB scoped-vmem cap rejects the bt=16 tile; v5e has
-    # far more physical VMEM.  (CompilerParams was TPUCompilerParams in
-    # older jax releases.)
+    # 128 MiB physical VMEM.  110 MiB admits the block3 chain (74x74,
+    # 128->256 channels) at bt=8, which peaks at ~107 MiB.
+    # (CompilerParams was TPUCompilerParams in older jax releases.)
     params_cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-    return params_cls(vmem_limit_bytes=100 * 1024 * 1024)
+    return params_cls(vmem_limit_bytes=110 * 1024 * 1024)
 
 
 def fused_sepconv_block(x, dw, pw, scale, shift, *, bt: int = 0, interpret: bool = False):

@@ -185,8 +185,8 @@ class InferenceEngine:
             # spec.family has no in-tree model, and the (slow) build/compile
             # is deferred to first debug use.  _fast is concretized so that
             # lazy build also never traces a fused program this device
-            # cannot compile (prefer_live is False on every path here).
-            self._fast = prefer_live
+            # cannot compile (prefer_live is statically False here).
+            self._fast = False
             self._jitted_f32 = None
         else:
             # build_forward branches on input dtype at trace time and jit

@@ -34,7 +34,18 @@ def _random_block_weights(rng, c):
     return dw, pw, s, b
 
 
-@pytest.mark.parametrize("shape", [(4, 6, 6, 256), (2, 5, 7, 128)])
+@pytest.mark.parametrize(
+    "shape",
+    [
+        (4, 6, 6, 256),
+        (2, 5, 7, 128),
+        # non-8-multiple batches (the serving buckets that killed BENCH_r02)
+        # run via sublane padding and must match on the real rows
+        (1, 6, 6, 128),
+        (3, 6, 6, 128),
+        (6, 6, 6, 128),
+    ],
+)
 def test_kernel_matches_reference(shape):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(0, 1, shape), jnp.bfloat16)
@@ -44,6 +55,7 @@ def test_kernel_matches_reference(shape):
         jax.jit(lambda *a: fused_sepconv_block(*a, interpret=True))(x, dw, pw, s, b),
         np.float32,
     )
+    assert got.shape == shape
     rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
     assert rel < 2e-2, f"kernel diverges from reference: {rel:.2e}"
 
@@ -90,23 +102,72 @@ def test_pick_batch_tile_rules():
     assert pick_batch_tile(1, 19, 19, 728) == 8
 
 
-@pytest.mark.parametrize("batch", [1, 2, 3, 6])
-def test_kernel_pads_unaligned_batches(batch):
-    """Batches that are not multiples of 8 (the serving buckets 1/2/4 that
-    killed BENCH_r02) run via sublane padding and still match the
-    reference numerics exactly on the real rows."""
-    rng = np.random.default_rng(7)
-    shape = (batch, 6, 6, 128)
-    x = jnp.asarray(rng.normal(0, 1, shape), jnp.bfloat16)
-    dw, pw, s, b = _random_block_weights(rng, shape[-1])
-    want = np.asarray(sepconv_block_reference(x, dw, pw, s, b), np.float32)
-    got = np.asarray(
-        jax.jit(lambda *a: fused_sepconv_block(*a, interpret=True))(x, dw, pw, s, b),
-        np.float32,
+def test_fused_entry_kernel_matches_reference():
+    """The fused entry kernel (conv2 + block2, ops.fused_entry) vs its
+    plain-jnp reference at a small parameterized geometry, interpret mode:
+    pins the halo/mask/stride-selection math, including a final partial
+    row tile (h_out=11, rt=4) and the batch-pad path (B=2 -> 8)."""
+    from kubernetes_deep_learning_tpu.ops.fused_entry import (
+        entry_block_reference,
+        fused_entry_block_t,
     )
-    assert got.shape == shape
+
+    rng = np.random.default_rng(3)
+    h_in, c_in, c_b, c_out = 23, 8, 16, 32  # h_b=21, h_out=11
+    w = {
+        "conv2": rng.normal(0, 0.2, (3, 3, c_in, c_b)).astype(np.float32),
+        "conv2_s": rng.uniform(0.8, 1.2, c_b).astype(np.float32),
+        "conv2_b": rng.normal(0, 0.1, c_b).astype(np.float32),
+        "res": rng.normal(0, 0.1, (c_b, c_out)).astype(np.float32),
+        "res_s": rng.uniform(0.8, 1.2, c_out).astype(np.float32),
+        "res_b": rng.normal(0, 0.1, c_out).astype(np.float32),
+        "dw1": rng.normal(0, 0.2, (3, 3, c_b)).astype(np.float32),
+        "pw1": rng.normal(0, 0.1, (c_b, c_out)).astype(np.float32),
+        "bn1_s": rng.uniform(0.8, 1.2, c_out).astype(np.float32),
+        "bn1_b": rng.normal(0, 0.1, c_out).astype(np.float32),
+        "dw2": rng.normal(0, 0.2, (3, 3, c_out)).astype(np.float32),
+        "pw2": rng.normal(0, 0.1, (c_out, c_out)).astype(np.float32),
+        "bn2_s": rng.uniform(0.8, 1.2, c_out).astype(np.float32),
+        "bn2_b": rng.normal(0, 0.1, c_out).astype(np.float32),
+    }
+    w = {k: jnp.asarray(v) for k, v in w.items()}
+    for batch in (2, 8):  # 2 exercises the pad-to-8 assert path upstream
+        a = jnp.asarray(rng.normal(0, 0.5, (8, h_in, h_in, c_in)), jnp.bfloat16)
+        a = a[:batch] if batch < 8 else a
+        want = np.asarray(entry_block_reference(a, w), np.float32)
+        a_t = jnp.pad(a, ((0, 8 - batch), (0, 0), (0, 0), (0, 0))).transpose(
+            1, 2, 0, 3
+        )
+        got_t = jax.jit(
+            lambda xt: fused_entry_block_t(xt, w, rt=4, interpret=True)
+        )(a_t)
+        got = np.asarray(got_t.transpose(2, 0, 1, 3)[:batch], np.float32)
+        assert got.shape == want.shape
+        rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+        assert rel < 2e-2, f"entry kernel diverges (batch {batch}): {rel:.2e}"
+
+
+def test_fast_forward_entry_kernel_matches_flax(fast_spec):
+    """The EXPERIMENTAL entry_kernel=True fast path end to end (fused entry
+    + block3/4 chains + middle + exit, interpret mode) vs the stock flax
+    graph -- kept tested even though serving does not enable it."""
+    from kubernetes_deep_learning_tpu.models.xception_fast import build_fast_forward
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    rng = np.random.default_rng(5)
+    variables = jax.tree_util.tree_map(np.asarray, init_variables(fast_spec, seed=4))
+    images = rng.integers(0, 256, (2, *fast_spec.input_shape), np.uint8)
+    ref = jax.jit(build_forward(fast_spec, dtype=jnp.bfloat16, fast=False))
+    want = np.asarray(ref(variables, images))
+
+    fast = build_fast_forward(
+        fast_spec, dtype=jnp.bfloat16, interpret=True, entry_kernel=True
+    )
+    x = normalize(jnp.asarray(images), fast_spec.preprocessing)
+    got = np.asarray(jax.jit(fast)(variables, x), np.float32)
+
     rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
-    assert rel < 2e-2, f"padded kernel diverges from reference: {rel:.2e}"
+    assert rel < 1e-2, f"entry-kernel fast path diverges from flax: {rel:.2e}"
 
 
 @pytest.fixture(scope="module")
