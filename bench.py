@@ -138,17 +138,30 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
     results = {}
     flops_img = None
     for b in batch_sizes:
-        # Auto-size the iteration count so the dev tunnel's ~70 ms dispatch
-        # RTT is amortized to <1% of each timed call: at the old fixed 30
-        # iterations it added ~2.3 ms/iteration to BOTH methods (round-3
-        # finding: the device stream was packed -- trace span 13.8 ms/iter
-        # at batch 64 -- while the bench reported 16.6).  Production PCIe
-        # dispatch is tens of us, so the RTT is a harness artifact, not
-        # serving cost; the two-method agreement check still applies.
-        k = scan_len or max(24, min(200, 25000 // b))
         x = jax.device_put(
             rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
         )
+        # Auto-size the iteration count so the dev tunnel's ~70 ms dispatch
+        # RTT is amortized to a ~1-2% effect: at the old fixed 30
+        # iterations it added ~2.3 ms/iteration to BOTH methods (round-3
+        # finding: the device stream was packed -- trace span 13.8 ms/iter
+        # at batch 64 -- while the bench reported 16.6).  A short pipelined
+        # probe estimates the warm per-iteration time, then k targets ~7 s
+        # per timed call (one RTT / 7 s = 1%; the probe's own RTT share
+        # inflates the estimate slightly, so the bound is ~1-2% at batch 1
+        # and tighter for bigger batches).  Production PCIe dispatch is
+        # tens of us, so the RTT is a harness artifact, not serving cost;
+        # the two-method agreement check still applies.
+        jax.block_until_ready(fwd_jit(variables, x))  # compile/warm this shape
+        if scan_len:
+            k = scan_len
+        else:
+            probe_n = max(8, min(64, 25000 // b))
+            t0 = time.perf_counter()
+            probe = [fwd_jit(variables, x) for _ in range(probe_n)]
+            jax.block_until_ready(probe)
+            est = (time.perf_counter() - t0) / probe_n
+            k = int(max(24, min(8000, 7.0 / est)))
         if flops_img is None:
             # Cost analysis on the flax graph (see compiled_flops_per_image);
             # the TIMED forward may be the fused fast path.
@@ -315,6 +328,107 @@ def bench_serving(duration_s, clients, batcher_impl, max_delay_ms, buckets):
         f"p99 {result['e2e_p99_ms']} ms, {errors[0]} errors"
     )
     return result
+
+
+def bench_batcher_sweep(duration_s, clients, device_ms_list, max_delay_ms):
+    """C++ vs Python batcher at controlled simulated device latencies.
+
+    The native batcher's claimed advantages are structural -- GIL-free
+    linger and depth-2 dispatch pipelining (assemble batch N+1 while batch
+    N executes).  This isolates them: both batchers drive the SAME
+    StubEngine with an async serial device (runtime.stub async_device) at
+    each latency in ``device_ms_list``; the difference is pure batcher
+    architecture, not device speed.  VERDICT r2 weak-6: replace the
+    'sized for PCIe-latency serving' hand-waving with this curve.
+    """
+    import tempfile
+    import threading
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+    from kubernetes_deep_learning_tpu.runtime.batcher import DynamicBatcher
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+
+    spec = get_spec("clothing-model")
+    rng = np.random.default_rng(0)
+    root = tempfile.mkdtemp(prefix="kdlt-bsweep-")
+    art.save_artifact(
+        art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    artifact = art.load_artifact(art.version_dir(root, spec.name, 1))
+
+    def make_native(engine):
+        from kubernetes_deep_learning_tpu.runtime.native_batcher import NativeBatcher
+
+        return NativeBatcher(engine, max_delay_ms=max_delay_ms)
+
+    impls = [("python", lambda e: DynamicBatcher(e, max_delay_ms=max_delay_ms))]
+    try:
+        import kubernetes_deep_learning_tpu.ops._native  # noqa: F401
+
+        impls.append(("native", make_native))
+    except Exception as e:  # noqa: BLE001
+        log(f"native batcher unavailable ({e!r}); sweeping python only")
+
+    results = {}
+    log(f"batcher sweep: {clients} client threads, {duration_s:.0f}s per point")
+    for dev_ms in device_ms_list:
+        row = {}
+        for name, make in impls:
+            engine = StubEngine(
+                artifact, device_ms_per_batch=dev_ms, async_device=True
+            )
+            engine.warmup()
+            batcher = make(engine)
+            stop = threading.Event()
+            counts = [0] * clients
+            lat = [[] for _ in range(clients)]
+            # Per-worker images generated BEFORE the threads start: numpy
+            # Generators are not thread-safe.
+            imgs = [
+                rng.integers(0, 256, size=(*spec.input_shape,), dtype=np.uint8)
+                for _ in range(clients)
+            ]
+
+            def worker(i, batcher=batcher, stop=stop, counts=counts, lat=lat):
+                img = imgs[i]
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    batcher.predict(img)
+                    lat[i].append(time.perf_counter() - t0)
+                    counts[i] += 1
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(duration_s)
+            stop.set()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            rps = sum(counts) / wall
+            all_lat = np.concatenate([np.asarray(x) for x in lat if x]) * 1e3
+            row[name] = {
+                "img_per_s": round(rps, 1),
+                "p50_ms": round(float(np.percentile(all_lat, 50)), 2),
+                "p99_ms": round(float(np.percentile(all_lat, 99)), 2),
+            }
+            batcher.close()
+            engine.close()
+        line = f"  device {dev_ms:5.1f} ms/batch: " + "  ".join(
+            f"{n} {r['img_per_s']:8.0f} img/s (p50 {r['p50_ms']:6.2f} ms)"
+            for n, r in row.items()
+        )
+        if "native" in row and "python" in row:
+            adv = row["native"]["img_per_s"] / max(row["python"]["img_per_s"], 1e-9)
+            line += f"  native/python = {adv:.2f}x"
+            row["native_advantage"] = round(adv, 3)
+        log(line)
+        results[dev_ms] = row
+    return results
 
 
 def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl, max_delay_ms):
@@ -518,7 +632,25 @@ def main() -> int:
         "--peak-tflops", type=float, default=0.0,
         help="device peak TFLOP/s for MFU (0 = auto-detect from device kind)",
     )
+    p.add_argument(
+        "--batcher-sweep", type=float, default=0,
+        help="seconds per point: C++ vs Python batcher at simulated device "
+             "latencies (--device-ms list), no real device needed",
+    )
+    p.add_argument(
+        "--device-ms", default="0.5,1,2,5,10",
+        help="simulated device ms/batch for --batcher-sweep",
+    )
     args = p.parse_args()
+
+    if args.batcher_sweep > 0:
+        bench_batcher_sweep(
+            args.batcher_sweep,
+            args.clients,
+            [float(d) for d in args.device_ms.split(",")],
+            args.max_delay_ms,
+        )
+        return 0
 
     if args.host_saturation > 0:
         bench_host_saturation(

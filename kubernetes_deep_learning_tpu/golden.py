@@ -61,6 +61,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--image-url", help="image URL for the gateway check")
     p.add_argument("--atol", type=float, default=0.05,
                    help="per-logit absolute tolerance (bf16 serving: try 0.2)")
+    p.add_argument("--served-atol", type=float, default=0.2,
+                   help="tolerance for the served-configuration check "
+                        "(bf16 + fused fast path where available)")
+    p.add_argument("--skip-served", action="store_true",
+                   help="only check the exact f32 flax graph (round-2 behavior)")
     p.add_argument("--platform", default=None, help="jax platform override")
     args = p.parse_args(argv)
 
@@ -92,8 +97,8 @@ def main(argv: list[str] | None = None) -> int:
         artifact = art.ModelArtifact(
             spec, variables, None, {"compute_dtype": "float32"}, path="<in-memory>/1"
         )
-        # fast=False: golden parity must check the exact flax graph, never
-        # the approximate fused fast path (models.xception_fast).
+        # fast=False: golden parity checks the exact flax graph first (the
+        # reference-parity gate proper)...
         engine = InferenceEngine(
             artifact, buckets=(1,), use_exported=False, fast=False
         )
@@ -106,6 +111,34 @@ def main(argv: list[str] | None = None) -> int:
             print("FAIL", f, file=sys.stderr)
         return 1
     print(f"OK: all {len(GOLDEN_LOGITS)} logits within atol={args.atol}, top-1 pants")
+
+    if not args.gateway and not args.skip_served:
+        # ...and then the configuration actually SERVED: bf16 compute with
+        # fast="auto", which on TPU is the fused Pallas path.  Without this
+        # the numeric gate never exercises the program serving runs
+        # (ADVICE r2: engine.prefer_live serves the fused path while golden
+        # pinned fast=False), so real-weight drift on the fast path went
+        # unvalidated.
+        served = InferenceEngine(
+            art.ModelArtifact(
+                spec, variables, None,
+                {"compute_dtype": "bfloat16"}, path="<in-memory>/1",
+            ),
+            buckets=(1,), use_exported=False, fast="auto",
+        )
+        served_scores = served.predict_scores(image[None])[0]
+        print(
+            "served-config scores:",
+            {k: round(v, 3) for k, v in sorted(served_scores.items())},
+        )
+        served_failures = check_scores(served_scores, args.served_atol)
+        if served_failures:
+            for f in served_failures:
+                print("FAIL (served config)", f, file=sys.stderr)
+            return 1
+        print(
+            f"OK: served config (bf16, fast=auto) within atol={args.served_atol}"
+        )
     return 0
 
 

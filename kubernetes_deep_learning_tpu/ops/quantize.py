@@ -155,6 +155,21 @@ def write_quantized_version(root: str, name: str) -> str:
     src = art.load_artifact(art.version_dir(root, name, version))
     if src.metadata.get("quantization"):
         raise ValueError(f"{name} v{version} is already quantized")
+    # Quantized artifacts drop the exported StableHLO (module=None below):
+    # they can only serve through the live-jit in-tree forward.  A family
+    # with no in-tree model would produce an unservable version that the
+    # version watcher warm-up-fails on every scan (ADVICE r2) -- fail HERE,
+    # at quantize time, instead.
+    from kubernetes_deep_learning_tpu.models import create_model
+
+    try:
+        create_model(src.spec)
+    except KeyError as e:
+        raise ValueError(
+            f"cannot quantize {name!r}: family {src.spec.family!r} has no "
+            "in-tree forward, and quantized artifacts (module=None) can "
+            "only serve via live jit"
+        ) from e
     qvars = quantize_variables(src.variables)
     meta = {
         **src.metadata,

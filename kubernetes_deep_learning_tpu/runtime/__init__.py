@@ -7,10 +7,30 @@ def create_batcher(engine, impl: str = "auto", **kwargs):
 
     "native" -> the C++ queue (native/batchqueue.cc); "python" -> the
     pure-Python DynamicBatcher; "auto" -> native when the compiled library
-    is available, else Python.  Both have identical policy and surface.
+    is available AND the host has a core to overlap with, else Python.
+    Both have identical policy and surface.
+
+    The core check is measured, not theoretical (bench.py --batcher-sweep,
+    BENCH.md round 3): the native batcher's depth-2 pipeline spreads
+    dispatch across threads (dispatcher, device sync, C++ completion), and
+    on a single-core host the GIL convoys those handoffs -- the Python
+    batcher's one-thread dispatch loop beats it at every simulated device
+    latency (0.5-10 ms).  The pipeline needs a second core to pay off.
     """
+    import os
+
     if impl not in ("auto", "native", "python"):
         raise ValueError(f"unknown batcher impl {impl!r}")
+    if impl == "auto":
+        # Affinity-aware count: os.cpu_count() reports HOST cores, so a
+        # 1-CPU-pinned container on a 64-core node would wrongly pick the
+        # native pipeline and hit the measured convoy.
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):  # non-Linux
+            cores = os.cpu_count() or 1
+        if cores < 2:
+            impl = "python"
     if impl in ("auto", "native"):
         try:
             from kubernetes_deep_learning_tpu.runtime.native_batcher import NativeBatcher

@@ -296,23 +296,43 @@ def serve_grpc(
     port: int,
     host: str = "0.0.0.0",
     max_workers: int = 16,
+    max_receive_bytes: int | None = None,
 ) -> tuple[grpc.Server, int]:
     """Start the gRPC frontend next to a ModelServer; returns (server, port).
 
-    The wire-level message bound is lifted to gRPC's maximum (the 4 MiB
-    default would reject legitimate float32 batches).  It is deliberately
-    NOT derived from the models loaded at startup: the version watcher can
-    hot-load a larger-input model later, and a startup-frozen bound would
-    reject its full-size batches at the transport before the servicer's
-    own per-model shape/batch checks ever ran.
+    The SEND bound is lifted to gRPC's maximum (responses are the server's
+    own, trusted).  The RECEIVE bound is a real resource guard (ADVICE r2):
+    the servicer's MAX_IMAGES_PER_REQUEST/shape checks only run after full
+    deserialization plus potential float32 casts, so an unbounded receive
+    limit lets one hostile ~2 GiB message force several GiB of transient
+    allocation.  Default: a full MAX_IMAGES_PER_REQUEST batch as UINT8
+    (+50% proto/framing headroom) over the models loaded at startup --
+    ~0.8 GiB for the 299x299 flagship, a bound that actually BINDS below
+    gRPC's 2 GiB ceiling (an f32 budget would not).  Consequence, stated:
+    float32-encoded requests are transport-capped at ~MAX/4 images; ship
+    big batches as uint8 (the gateway does).  A model hot-loaded later
+    with a LARGER input shape needs a restart or an explicit
+    ``max_receive_bytes`` -- the documented trade for a pre-parse guard.
     """
     limit = 2**31 - 1  # gRPC messages are int32-length-prefixed
+    if max_receive_bytes is None:
+        from kubernetes_deep_learning_tpu.serving.model_server import (
+            MAX_IMAGES_PER_REQUEST,
+        )
+
+        budgets = [
+            MAX_IMAGES_PER_REQUEST * int(np.prod(m.artifact.spec.input_shape))
+            for m in getattr(model_server, "models", {}).values()
+        ]
+        max_receive_bytes = (
+            min(limit, int(max(budgets) * 1.5) + (1 << 20)) if budgets else limit
+        )
     server = grpc.server(
         futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="kdlt-grpc"
         ),
         options=[
-            ("grpc.max_receive_message_length", limit),
+            ("grpc.max_receive_message_length", int(max_receive_bytes)),
             ("grpc.max_send_message_length", limit),
         ],
     )

@@ -114,9 +114,13 @@ class UpstreamMicroBatcher:
             if not batch:
                 continue
             images = np.stack([b[0] for b in batch])
-            rid = batch[0][1]  # trace under the first waiter's id; the
-            # upstream log line carries the batch size so the fan-in is
-            # visible from either tier's logs.
+            # Trace the coalesced flush under EVERY member's request id
+            # (joined, truncated): with only the first waiter's id, the
+            # gateway->model hop was invisible to an X-Request-Id grep for
+            # the other members (ADVICE r2).  The upstream log line carries
+            # the batch size so the fan-in stays visible from either tier.
+            rids = [b[1] for b in batch if b[1]]
+            rid = ",".join(rids[:8]) + (f",+{len(rids) - 8}" if len(rids) > 8 else "")
             try:
                 rows, labels = self._predict_batch(images, rid)
                 if len(rows) < len(batch):

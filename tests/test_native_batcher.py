@@ -3,6 +3,7 @@ scenarios so both implementations provably share policy and surface."""
 
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
 from types import SimpleNamespace
 
@@ -46,12 +47,71 @@ def _img(value: int) -> np.ndarray:
     return np.full((2, 2, 3), value, np.uint8)
 
 
-def test_create_batcher_auto_picks_native():
+def test_create_batcher_auto_respects_core_count(monkeypatch):
+    import os
+
+    from kubernetes_deep_learning_tpu.runtime import DynamicBatcher
+
+    # With a core to overlap with, auto picks the C++ queue.  (The check is
+    # affinity-aware, so patch sched_getaffinity where it exists.)
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    if hasattr(os, "sched_getaffinity"):
+        monkeypatch.setattr(os, "sched_getaffinity", lambda pid: {0, 1, 2, 3})
     b = create_batcher(FakeEngine(), impl="auto", max_delay_ms=1)
     try:
         assert isinstance(b, NativeBatcher)
     finally:
         b.close()
+    # On a single-core host the GIL convoys the native pipeline's
+    # cross-thread handoffs (measured: bench.py --batcher-sweep, BENCH.md
+    # round 3), so auto degrades to the one-thread Python dispatcher.
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    if hasattr(os, "sched_getaffinity"):
+        monkeypatch.setattr(os, "sched_getaffinity", lambda pid: {0})
+    b = create_batcher(FakeEngine(), impl="auto", max_delay_ms=1)
+    try:
+        assert isinstance(b, DynamicBatcher)
+    finally:
+        b.close()
+
+
+def test_native_batcher_async_stub_correctness():
+    """The depth-2 pipeline against the async serial-device stub
+    (runtime.stub async_device): concurrent requests must map back to
+    their own checksum rows even with a batch in flight during assembly
+    -- the aliasing/ping-pong contract under real overlap."""
+    import tempfile
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine, stub_logits
+
+    spec = register_spec(
+        ModelSpec(
+            name="nb-async-stub",
+            family="xception",
+            input_shape=(8, 8, 3),
+            labels=("a", "b"),
+            preprocessing="tf",
+        )
+    )
+    root = tempfile.mkdtemp()
+    art.save_artifact(art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {})
+    artifact = art.load_artifact(art.version_dir(root, spec.name, 1))
+    eng = StubEngine(artifact, device_ms_per_batch=1.0, async_device=True)
+    eng.warmup()
+    assert hasattr(eng, "predict_async")
+    b = NativeBatcher(eng, max_delay_ms=1)
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (24, *spec.input_shape), np.uint8)
+    try:
+        with ThreadPoolExecutor(max_workers=12) as pool:
+            outs = list(pool.map(b.predict, imgs))
+        want = stub_logits(imgs, spec.num_classes)
+        np.testing.assert_allclose(np.stack(outs), want)
+    finally:
+        b.close()
+        eng.close()
 
 
 def test_single_request_roundtrip():
