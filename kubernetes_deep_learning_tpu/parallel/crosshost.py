@@ -18,15 +18,32 @@ This module supplies the missing coordination.  After
   lockstep (collectives ride ICI within a slice / DCN across), and the
   data-sharded logits are allgathered back to the leader.
 
-Dispatch protocol: one fixed-shape (flag, batch) broadcast per round --
-fixed shapes because broadcast participants must agree on the pytree
-structure before payload arrives.  flag SHUTDOWN ends the followers, so a
-leader can drain the fleet cleanly.  Batches pad to ``bucket`` exactly like
-the single-host engine's bucket ladder (runtime.engine).
+Dispatch protocol (round 3 -- two-phase): each round broadcasts a tiny
+fixed-shape CONTROL pair ``(flag, aux)`` first, then a payload whose shape
+the control determined -- so the fleet supports a real bucket LADDER
+instead of round 2's single fixed dispatch shape, plus hot version reload:
+
+- ``PREDICT``: aux = bucket; payload = the (bucket, H, W, C) uint8 batch.
+- ``RELOAD``:  aux = version; no payload.  Every process loads that version
+  from its OWN model root (shared storage or identical image -- the same
+  assumption boot-time loading already makes) and re-shards the variables.
+- ``SHUTDOWN``: no payload; followers return.
+
+Crash semantics (k8s restart story): the fleet is one gang.  If a follower
+dies mid-round, the leader's collective blocks forever -- so the leader
+arms a per-round watchdog (``round_timeout_s``) that exits the process
+(code 70) when a round wedges; the pod's restart then restarts the WHOLE
+fleet together (a k8s Deployment/JobSet restarts the gang -- jax.distributed
+processes cannot rejoin a live runtime).  If the leader dies, followers'
+pending broadcast errors out of ``follower_loop`` and their pods restart
+the same way.  Tested in tests/test_crosshost.py (follower-death ->
+leader exit 70; reload round-trip).
 """
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import Any
 
 import numpy as np
@@ -34,7 +51,26 @@ import numpy as np
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
 
-_PREDICT, _SHUTDOWN = 1.0, 0.0
+_PREDICT, _SHUTDOWN, _RELOAD = 1.0, 0.0, 2.0
+
+
+def artifact_variables_for_sharding(artifact):
+    """An artifact's variables ready for shard_variables: int8 weight-only
+    trees (ops.quantize) dequantize host-side first, because the partition
+    rules address float kernel leaves (same handling as the engine's mesh
+    path and _serve_cross_host's boot path)."""
+    if artifact.metadata.get("quantization"):
+        from kubernetes_deep_learning_tpu.ops.quantize import (
+            SCHEME,
+            dequantize_variables_host,
+        )
+
+        if artifact.metadata["quantization"] != SCHEME:
+            raise ValueError(
+                f"unknown quantization scheme {artifact.metadata['quantization']!r}"
+            )
+        return dequantize_variables_host(artifact.variables)
+    return artifact.variables
 
 
 class CrossHostForward:
@@ -45,11 +81,70 @@ class CrossHostForward:
         spec: ModelSpec,
         mesh,
         variables: Any,
-        bucket: int = 0,
+        buckets: Any = (0,),
         dtype: Any = None,
+        model_root: str | None = None,
+        model_name: str | None = None,
+        round_timeout_s: float = 0.0,
     ):
+        """``buckets``: dispatch ladder; each entry is rounded up to a
+        multiple of the data-axis size (0 = the axis size itself).
+        ``model_root``/``model_name`` enable RELOAD (every process must see
+        the same versioned artifact tree).  ``round_timeout_s`` > 0 arms
+        the leader's per-round watchdog (see module docstring)."""
         import jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.spec = spec
+        self.mesh = mesh
+        n_data = mesh.shape[DATA_AXIS]
+        self.buckets = tuple(sorted({-(-(b or n_data) // n_data) * n_data for b in buckets}))
+        self.bucket = self.buckets[-1]  # largest; also the legacy attr
+        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._dtype = dtype or jnp.bfloat16
+        self.model_root = model_root
+        self.model_name = model_name
+        self.round_timeout_s = round_timeout_s
+        self.version: int | None = None
+        # Serializes ALL leader rounds across every consumer of this
+        # forward: during a hot reload the version watcher constructs a
+        # fresh engine while the old one still serves, and a reload
+        # broadcast interleaved with a predict round would corrupt the
+        # lockstep protocol fleet-wide.
+        self._round_lock = threading.Lock()
+        self._install_variables(variables)
+        # Rows of each bucket owned by THIS process, derived from the
+        # mesh's actual device->process ownership (ADVICE r2: the old
+        # process_count() equal-split silently mis-sharded any mesh that
+        # did not cover all devices with equal per-process counts).
+        self._local_rows = {}
+        for b in self.buckets:
+            imap = self._batch_sharding.devices_indices_map((b, *spec.input_shape))
+            # set: under model parallelism rows are replicated across the
+            # model axis, so each span appears once per model-axis device.
+            spans = sorted(
+                {
+                    (sl[0].start or 0, b if sl[0].stop is None else sl[0].stop)
+                    for d, sl in imap.items()
+                    if d.process_index == jax.process_index()
+                }
+            )
+            if not spans:
+                raise ValueError(
+                    f"process {jax.process_index()} owns no devices of the "
+                    "serving mesh; every process in the runtime must "
+                    "participate (build the mesh over all of jax.devices())"
+                )
+            start, stop = spans[0][0], spans[-1][1]
+            if any(spans[i][1] != spans[i + 1][0] for i in range(len(spans) - 1)):
+                raise ValueError(
+                    f"non-contiguous local rows for bucket {b}: {spans}"
+                )
+            self._local_rows[b] = (start, stop)
+
+    def _install_variables(self, variables: Any) -> None:
+        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from kubernetes_deep_learning_tpu.models import build_forward
@@ -57,98 +152,143 @@ class CrossHostForward:
             shard_variables,
         )
 
-        self.spec = spec
-        self.mesh = mesh
-        n_data = mesh.shape[DATA_AXIS]
-        # One fixed dispatch shape: smallest multiple of the data axis that
-        # is >= the requested bucket (0 = the axis size itself).
-        bucket = bucket or n_data
-        self.bucket = -(-bucket // n_data) * n_data
-        self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
-        self._dtype = dtype or jnp.bfloat16
         # Sharded/replicated per dataparallel's partition rules; identical
         # on every process because `variables` must be identical (same
         # artifact/seed) on every process.
-        self._variables = shard_variables(variables, mesh)
+        self._variables = shard_variables(variables, self.mesh)
         # fast=False: see parallel.dataparallel (sharded batch dims).
-        forward = build_forward(spec, dtype=self._dtype, fast=False)
+        forward = build_forward(self.spec, dtype=self._dtype, fast=False)
         self._jitted = jax.jit(
-            forward, out_shardings=NamedSharding(mesh, P(DATA_AXIS))
+            forward, out_shardings=NamedSharding(self.mesh, P(DATA_AXIS))
         )
 
-    def _local_shard(self, batch: np.ndarray) -> np.ndarray:
-        """The rows of ``batch`` this process's devices own under the
-        data-axis sharding (contiguous block per process for a mesh built
-        over jax.devices(), whose order groups by process)."""
-        import jax
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch {n} exceeds cross-host max bucket {self.bucket}")
 
-        per_proc = batch.shape[0] // jax.process_count()
-        start = jax.process_index() * per_proc
-        return batch[start : start + per_proc]
+    def _local_shard(self, batch: np.ndarray) -> np.ndarray:
+        start, stop = self._local_rows[batch.shape[0]]
+        return batch[start:stop]
 
     # --- leader (process 0) ----------------------------------------------
 
     def predict(self, images: np.ndarray) -> np.ndarray:
-        """Leader entry: uint8 (N,H,W,C), N <= bucket -> float32 (N, classes)."""
+        """Leader entry: uint8 (N,H,W,C), N <= max bucket -> f32 (N, classes)."""
         import jax
 
         assert jax.process_index() == 0, "predict() is the leader's call"
         n = images.shape[0]
-        if n > self.bucket:
-            raise ValueError(f"batch {n} exceeds cross-host bucket {self.bucket}")
-        pad = np.zeros((self.bucket - n, *self.spec.input_shape), np.uint8)
-        payload = self._payload(_PREDICT, np.concatenate([images, pad]))
-        return self._round_from_payload(payload)[:n]
+        bucket = self.bucket_for(n)
+        pad = np.zeros((bucket - n, *self.spec.input_shape), np.uint8)
+        batch = np.concatenate([images, pad])
+        with self._round_lock, self._watchdog("predict round"):
+            self._send_control(_PREDICT, float(bucket))
+            self._broadcast_payload(batch)
+            return self._run_round(batch)[:n]
+
+    def reload(self, version: int, variables: Any = None) -> None:
+        """Leader: hot-swap the fleet to artifact ``version``.
+
+        The leader loads (or is handed) and VALIDATES the new variables
+        BEFORE broadcasting RELOAD: a leader-side failure then raises with
+        the fleet untouched and still version-consistent.  Broadcasting
+        first would let followers swap while the leader kept the old
+        weights -- silent mixed-version logits.  A FOLLOWER-side reload
+        failure (e.g. shared-storage race) raises out of follower_loop and
+        kills that process; the gang restart (module docstring) restores
+        consistency.  The caller must serialize this against predict()
+        (CrossHostEngine holds its lock; _round_lock backstops).
+        """
+        import jax
+
+        assert jax.process_index() == 0, "reload() is the leader's call"
+        if self.model_root is None or self.model_name is None:
+            raise RuntimeError("reload requires model_root/model_name")
+        if variables is None:
+            variables = self._load_version_variables(int(version))
+        with self._round_lock, self._watchdog(f"reload to v{version}"):
+            self._send_control(_RELOAD, float(version))
+            self._install_variables(variables)
+            self.version = int(version)
 
     def shutdown(self) -> None:
         """Leader: release followers from follower_loop()."""
         import jax
 
         if jax.process_index() == 0:
-            payload = self._payload(
-                _SHUTDOWN, np.zeros((self.bucket, *self.spec.input_shape), np.uint8)
-            )
-            self._round_from_payload(payload, run=False)
+            with self._round_lock:
+                self._send_control(_SHUTDOWN, 0.0)
 
     # --- follower (process > 0) ------------------------------------------
 
     def follower_loop(self) -> int:
         """Block serving lockstep rounds until the leader shuts down.
 
-        Returns the number of predict rounds served.
+        Returns the number of predict rounds served.  A dead leader
+        surfaces as an exception from the pending broadcast; the caller's
+        process exits and the pod restart restarts the gang.
         """
         import jax
 
         assert jax.process_index() != 0, "follower_loop() is for processes > 0"
         rounds = 0
         while True:
-            flagged = self._recv_payload()
-            if flagged[0] == _SHUTDOWN:
+            flag, aux = self._recv_control()
+            if flag == _SHUTDOWN:
                 return rounds
-            self._run_round(flagged[1])
+            if flag == _RELOAD:
+                self._do_reload(int(aux))
+                continue
+            batch = self._broadcast_payload(
+                np.zeros((int(aux), *self.spec.input_shape), np.uint8)
+            )
+            self._run_round(batch)
             rounds += 1
 
     # --- shared plumbing ---------------------------------------------------
 
-    def _payload(self, flag: float, batch: np.ndarray):
-        return (np.float32(flag), batch)
-
-    def _round_from_payload(self, payload, run: bool = True):
+    def _send_control(self, flag: float, aux: float) -> None:
         from jax.experimental import multihost_utils
 
-        flag, batch = multihost_utils.broadcast_one_to_all(payload)
-        if not run:
-            return None
-        return self._run_round(batch)
-
-    def _recv_payload(self):
-        from jax.experimental import multihost_utils
-
-        zero = self._payload(
-            _PREDICT, np.zeros((self.bucket, *self.spec.input_shape), np.uint8)
+        multihost_utils.broadcast_one_to_all(
+            (np.float32(flag), np.float32(aux))
         )
-        flag, batch = multihost_utils.broadcast_one_to_all(zero)
-        return float(flag), batch
+
+    def _recv_control(self) -> tuple[float, float]:
+        from jax.experimental import multihost_utils
+
+        flag, aux = multihost_utils.broadcast_one_to_all(
+            (np.float32(0), np.float32(0))
+        )
+        return float(flag), float(aux)
+
+    def _broadcast_payload(self, batch: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.broadcast_one_to_all(batch))
+
+    def _do_reload(self, version: int) -> None:
+        """Follower side of a RELOAD round."""
+        self._install_variables(self._load_version_variables(version))
+        self.version = version
+
+    def _load_version_variables(self, version: int):
+        """Load a version's variables from this process's model root, with
+        the same quantized-artifact handling as the boot path (the
+        shard/forward path addresses float kernel leaves, so int8 wire
+        trees must dequantize host-side before sharding)."""
+        if self.model_root is None or self.model_name is None:
+            raise RuntimeError(
+                "RELOAD requires model_root/model_name on every process"
+            )
+        from kubernetes_deep_learning_tpu.export import artifact as art
+
+        artifact = art.load_artifact(
+            art.version_dir(self.model_root, self.model_name, version)
+        )
+        return artifact_variables_for_sharding(artifact)
 
     def _run_round(self, batch: np.ndarray) -> np.ndarray:
         import jax
@@ -162,6 +302,38 @@ class CrossHostForward:
 
         return np.asarray(multihost_utils.process_allgather(logits, tiled=True))
 
+    def _watchdog(self, what: str):
+        """Context manager: exit(70) if a lockstep round wedges (dead
+        follower).  A blocked collective cannot be interrupted from Python,
+        so process exit -- and the pod restart it triggers -- is the only
+        clean recovery; the whole gang restarts together."""
+
+        class _Arm:
+            def __init__(self, timeout, what):
+                self._timer = None
+                if timeout > 0:
+                    def boom():
+                        print(
+                            f"CRITICAL cross-host {what} exceeded {timeout}s "
+                            "(dead peer?); exiting 70 for a gang restart",
+                            flush=True,
+                        )
+                        os._exit(70)
+
+                    self._timer = threading.Timer(timeout, boom)
+                    self._timer.daemon = True
+
+            def __enter__(self):
+                if self._timer is not None:
+                    self._timer.start()
+
+            def __exit__(self, *exc):
+                if self._timer is not None:
+                    self._timer.cancel()
+                return False
+
+        return _Arm(self.round_timeout_s, what)
+
 
 class CrossHostEngine:
     """Engine-shaped adapter: plugs CrossHostForward into the model server.
@@ -173,17 +345,35 @@ class CrossHostEngine:
     """
 
     def __init__(self, artifact, xh: CrossHostForward, registry=None, **_ignored):
-        import threading
-
         self.spec = artifact.spec
         self._xh = xh
-        self.buckets = (xh.bucket,)
+        self.buckets = xh.buckets
         self.max_batch = xh.bucket
         self._ready = False
+        # Hot version reload: ModelServer's version watcher constructs a
+        # fresh engine for a higher version dir through engine_factory --
+        # for cross-host serving the SWAP must happen fleet-wide, so
+        # construction broadcasts RELOAD when this artifact's version
+        # differs from the fleet's current one.  A failed reload raises
+        # here, and poll_versions keeps serving the old version.
+        try:
+            version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
+        except (AttributeError, ValueError):
+            version = None
+        if (
+            version is not None
+            and xh.version is not None
+            and version != xh.version
+        ):
+            # poll_versions already loaded this artifact; hand its
+            # variables over so the leader does not re-read the same
+            # version dir (and hold two host-RAM copies) during the swap.
+            xh.reload(version, variables=artifact_variables_for_sharding(artifact))
         # The lockstep protocol is strictly one round at a time: followers
-        # do exactly one _recv_payload per round, so two leader threads
+        # do exactly one control-recv per round, so two leader threads
         # interleaving broadcasts would cross payloads and hang the fleet.
-        # (InferenceEngine serializes dispatch the same way.)
+        # (InferenceEngine serializes dispatch the same way.)  reload()
+        # takes the same lock, so a version swap cannot split a round.
         self._lock = threading.Lock()
         self._m_images = None
         if registry is not None:
@@ -204,12 +394,13 @@ class CrossHostEngine:
 
         t0 = time.perf_counter()
         with self._lock:
-            self._xh.predict(np.zeros((1, *self.spec.input_shape), np.uint8))
+            for b in self.buckets:
+                self._xh.predict(np.zeros((b, *self.spec.input_shape), np.uint8))
         self._ready = True
         return time.perf_counter() - t0
 
     def bucket_for(self, n: int) -> int:
-        return self.max_batch
+        return self._xh.bucket_for(n)
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         if images.dtype != np.uint8:
@@ -221,3 +412,9 @@ class CrossHostEngine:
         if self._m_images is not None:
             self._m_images.inc(images.shape[0])
         return out
+
+    def reload(self, version: int) -> None:
+        """Fleet-wide hot version swap (serialized against predicts)."""
+        with self._lock:
+            self._xh.reload(version)
+        self._ready = True

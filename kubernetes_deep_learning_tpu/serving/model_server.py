@@ -522,6 +522,14 @@ def _serve_cross_host(args) -> int:
     from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
 
     n = args.data_parallel or len(jax.devices())
+    if n != len(jax.devices()):
+        # ADVICE r2: the lockstep shard math requires every process of the
+        # runtime to own mesh devices; a sub-mesh would leave processes
+        # with no shard (or unequal blocks) and mis-drive the broadcast.
+        raise SystemExit(
+            f"--cross-host requires the mesh to cover all {len(jax.devices())} "
+            f"global devices (got --data-parallel {n}); scale by adding hosts"
+        )
     mesh = make_mesh(
         n, model_parallel=args.model_parallel, devices=jax.devices()[:n]
     )
@@ -531,35 +539,31 @@ def _serve_cross_host(args) -> int:
     (name,) = _single_model_name(args.models)
     version = art.latest_version(args.models, name)
     artifact = art.load_artifact(art.version_dir(args.models, name, version))
-    if artifact.metadata.get("quantization"):
-        # kdlt-quantize'd artifact: the shard/forward path addresses float
-        # kernel leaves, so dequantize host-side before sharding (same as
-        # InferenceEngine's mesh path).
-        from kubernetes_deep_learning_tpu.ops.quantize import (
-            SCHEME,
-            dequantize_variables_host,
-        )
+    from kubernetes_deep_learning_tpu.parallel.crosshost import (
+        artifact_variables_for_sharding,
+    )
 
-        if artifact.metadata["quantization"] != SCHEME:
-            raise ValueError(
-                f"unknown quantization scheme {artifact.metadata['quantization']!r}"
-            )
-        import dataclasses
-
-        artifact = dataclasses.replace(
-            artifact, variables=dequantize_variables_host(artifact.variables)
-        )
+    # kdlt-quantize'd artifacts dequantize host-side before sharding (the
+    # partition rules address float kernel leaves) -- same helper the
+    # RELOAD path uses.
+    variables = artifact_variables_for_sharding(artifact)
     xh = CrossHostForward(
         artifact.spec,
         mesh,
-        artifact.variables,
-        bucket=args.cross_host_bucket,
+        variables,
+        buckets=tuple(
+            int(b) for b in str(args.cross_host_bucket).split(",")
+        ),
+        model_root=args.models,
+        model_name=name,
+        round_timeout_s=args.cross_host_round_timeout,
     )
+    xh.version = version  # the booted version; reload() tracks from here
     # xh holds the (device-sharded) weights; drop the host-RAM copy before
     # ModelServer loads its own artifact (whose copy CrossHostEngine also
     # frees) -- large models must not sit in host memory twice for the
     # server's lifetime.
-    del artifact
+    del artifact, variables
     if jax.process_index() != 0:
         print(
             f"cross-host follower {jax.process_index()}/{jax.process_count()} "
@@ -579,9 +583,15 @@ def _serve_cross_host(args) -> int:
         engine_factory=lambda artifact, **kw: CrossHostEngine(artifact, xh, **kw),
     )
     server.warmup()
+    # Fleet-wide hot reload: the standard version watcher drives it -- a
+    # higher version dir makes poll_versions construct a fresh
+    # CrossHostEngine, whose __init__ broadcasts RELOAD to the followers
+    # (parallel.crosshost).  Round-2 limitation closed.
+    server.start_version_watcher()
     print(
         f"cross-host model server on :{server.port} "
-        f"({jax.process_count()} processes, {n} global devices)"
+        f"({jax.process_count()} processes, {n} global devices, "
+        f"buckets {xh.buckets})"
     )
     try:
         server.start(block=True)
@@ -684,9 +694,16 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument(
         "--cross-host-bucket",
-        type=int,
-        default=0,
-        help="fixed dispatch batch for --cross-host (0 = data-axis size)",
+        default="0",
+        help="dispatch bucket ladder for --cross-host, comma-separated "
+             "(each rounded up to the data-axis size; 0 = the axis size)",
+    )
+    p.add_argument(
+        "--cross-host-round-timeout",
+        type=float,
+        default=300.0,
+        help="leader watchdog: exit(70) for a gang restart if one lockstep "
+             "round exceeds this many seconds (dead follower); 0 disables",
     )
     args = p.parse_args(argv)
 

@@ -50,7 +50,7 @@ spec = register_spec(ModelSpec(
 ))
 variables = init_variables(spec, seed=7)  # same seed -> identical everywhere
 mesh = make_mesh(8, devices=jax.devices())
-xh = CrossHostForward(spec, mesh, variables, bucket=8)
+xh = CrossHostForward(spec, mesh, variables, buckets=(4, 8))
 
 mode = sys.argv[1]
 if mode == "follower":
@@ -93,7 +93,7 @@ spec = register_spec(ModelSpec(
 ))
 variables = init_variables(spec, seed=9)
 mesh = make_mesh(8, devices=jax.devices())
-xh = CrossHostForward(spec, mesh, variables, bucket=8)
+xh = CrossHostForward(spec, mesh, variables, buckets=(8,))
 
 if jax.process_index() != 0:
     xh.follower_loop()
@@ -137,7 +137,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_fleet(worker_src: str, timeout: int = 420):
+def _run_fleet_raw(worker_src: str, timeout: int = 420, extra_args=()):
+    """Run leader+follower; returns [(returncode, output), ...] unasserted."""
     port = _free_port()
     env_base = {
         **os.environ,
@@ -150,7 +151,7 @@ def _run_fleet(worker_src: str, timeout: int = 420):
         env = {**env_base, "KDLT_PROCESS_ID": str(pid)}
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", worker_src, mode],
+                [sys.executable, "-c", worker_src, mode, *extra_args],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
@@ -158,7 +159,7 @@ def _run_fleet(worker_src: str, timeout: int = 420):
                 cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             )
         )
-    outs = []
+    results = []
     for p in procs:
         try:
             out, _ = p.communicate(timeout=timeout)
@@ -166,16 +167,143 @@ def _run_fleet(worker_src: str, timeout: int = 420):
             for q in procs:
                 q.kill()
             pytest.fail("cross-host fleet timed out")
-        outs.append(out)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
-    return outs
+        results.append((p.returncode, out))
+    return results
+
+
+def _run_fleet(worker_src: str, timeout: int = 420, extra_args=()):
+    results = _run_fleet_raw(worker_src, timeout=timeout, extra_args=extra_args)
+    for rc, out in results:
+        assert rc == 0, f"worker failed:\n{out[-3000:]}"
+    return [out for _, out in results]
+
+
+_RELOAD_WORKER = r"""
+import os, sys, tempfile
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+from kubernetes_deep_learning_tpu.utils.platform import force_platform
+force_platform("cpu")
+from kubernetes_deep_learning_tpu.utils.distributed import initialize
+assert initialize()
+import jax
+import jax.numpy as jnp
+import numpy as np
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostForward
+from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+from kubernetes_deep_learning_tpu.export import artifact as art
+
+spec = register_spec(ModelSpec(
+    name="xh-reload", family="vit-tiny", input_shape=(16, 16, 3),
+    labels=("a", "b", "c"), preprocessing="tf",
+))
+# A SHARED model root both processes can load versions from (the same
+# assumption production makes: shared storage / identical image).
+root = sys.argv[2]
+v1 = init_variables(spec, seed=9)
+v2 = init_variables(spec, seed=21)
+if jax.process_index() == 0:
+    art.save_artifact(art.version_dir(root, spec.name, 1), spec, v1, None, {})
+    art.save_artifact(art.version_dir(root, spec.name, 2), spec, v2, None, {})
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("artifacts-written")
+
+mesh = make_mesh(8, devices=jax.devices())
+xh = CrossHostForward(
+    spec, mesh, v1, buckets=(8,), model_root=root, model_name=spec.name,
+)
+xh.version = 1
+
+mode = sys.argv[1]
+if mode == "follower":
+    rounds = xh.follower_loop()
+    assert rounds == 2, f"expected 2 predict rounds across the reload, got {rounds}"
+    print("FOLLOWER-OK", flush=True)
+else:
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (5, *spec.input_shape), np.uint8)
+    ref1 = jax.jit(build_forward(spec, dtype=jnp.bfloat16, fast=False))
+    got1 = xh.predict(images)
+    np.testing.assert_allclose(got1, np.asarray(ref1(v1, images)), rtol=2e-2, atol=2e-2)
+    xh.reload(2)
+    assert xh.version == 2
+    got2 = xh.predict(images)
+    np.testing.assert_allclose(got2, np.asarray(ref1(v2, images)), rtol=2e-2, atol=2e-2)
+    assert np.abs(got1 - got2).max() > 1e-3, "reload served identical logits"
+    xh.shutdown()
+    print("LEADER-OK", flush=True)
+"""
+
+_DEATH_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+from kubernetes_deep_learning_tpu.utils.platform import force_platform
+force_platform("cpu")
+from kubernetes_deep_learning_tpu.utils.distributed import initialize
+assert initialize()
+import jax
+import numpy as np
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostForward
+from kubernetes_deep_learning_tpu.models import init_variables
+
+spec = register_spec(ModelSpec(
+    name="xh-death", family="vit-tiny", input_shape=(16, 16, 3),
+    labels=("a", "b", "c"), preprocessing="tf",
+))
+variables = init_variables(spec, seed=3)
+mesh = make_mesh(8, devices=jax.devices())
+xh = CrossHostForward(spec, mesh, variables, buckets=(8,), round_timeout_s=20)
+
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("fleet-up")
+
+if sys.argv[1] == "follower":
+    # Crash WITHOUT entering the loop: the leader's next round has a dead
+    # peer and must not hang forever.
+    os._exit(1)
+rng = np.random.default_rng(0)
+try:
+    xh.predict(rng.integers(0, 256, (8, *spec.input_shape), np.uint8))
+except BaseException as e:  # runtime error surfacing the dead peer: also OK
+    # os._exit: the jax distributed atexit shutdown would itself raise on
+    # the dead-peer barrier and mangle the exit code.
+    print(f"LEADER-ERROR {type(e).__name__}", flush=True)
+    os._exit(70)
+print("LEADER-UNEXPECTED-SUCCESS", flush=True)
+os._exit(1)
+"""
 
 
 def test_two_process_spmd_predict():
     leader_out, follower_out = _run_fleet(_WORKER)
     assert "LEADER-OK" in leader_out, leader_out[-2000:]
     assert "FOLLOWER-OK" in follower_out, follower_out[-2000:]
+
+
+def test_reload_round_trip():
+    """Fleet-wide hot version reload: v1 predicts, RELOAD broadcast, v2
+    predicts -- all against single-process references (VERDICT r2 #5)."""
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="kdlt-xh-reload-")
+    leader_out, follower_out = _run_fleet(_RELOAD_WORKER, extra_args=[root])
+    assert "LEADER-OK" in leader_out, leader_out[-2000:]
+    assert "FOLLOWER-OK" in follower_out, follower_out[-2000:]
+
+
+def test_follower_death_does_not_hang_leader():
+    """Crash semantics: a dead follower must end the leader's round with
+    exit 70 (watchdog or surfaced runtime error), never an indefinite
+    hang -- k8s then restarts the gang (VERDICT r2 #5)."""
+    leader, follower = _run_fleet_raw(_DEATH_WORKER, timeout=180)
+    (l_rc, l_out), (f_rc, f_out) = leader, follower
+    assert f_rc == 1, f_out[-1000:]
+    assert l_rc == 70, f"leader rc {l_rc}:\n{l_out[-2000:]}"
 
 
 def test_two_process_http_serving():
