@@ -16,11 +16,12 @@ FROM python:3.11-slim
 ENV PYTHONUNBUFFERED=TRUE
 
 WORKDIR /app
-COPY pyproject.toml constraints.txt ./
+COPY pyproject.toml requirements.lock ./
 COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
-# constraints.txt pins exact versions (the reference's Pipfile.lock role).
+# requirements.lock pins the full transitive closure (the reference's
+# Pipfile.lock role).
 # .[serve] adds gunicorn so either entrypoint below works.
-RUN pip install --no-cache-dir -c constraints.txt ".[serve]"
+RUN pip install --no-cache-dir -c requirements.lock ".[serve]"
 
 EXPOSE 9696
 # Model-tier discovery via KDLT_SERVING_HOST (k8s DNS), localhost fallback for

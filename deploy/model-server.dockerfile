@@ -20,16 +20,17 @@ ENV PYTHONUNBUFFERED=TRUE
 # Constrained from the very first resolve: an unpinned jax[tpu] here would
 # pull a libtpu matched to a NEWER jaxlib than the pinned one installed
 # below, and the stale PJRT plugin fails at runtime on the TPU node.
-COPY constraints.txt /tmp/constraints.txt
-RUN pip install --no-cache-dir -c /tmp/constraints.txt "jax[tpu]" \
+COPY requirements.lock /tmp/requirements.lock
+RUN pip install --no-cache-dir -c /tmp/requirements.lock "jax[tpu]" \
       -f https://storage.googleapis.com/jax-releases/libtpu_releases.html || \
-    pip install --no-cache-dir -c /tmp/constraints.txt jax
+    pip install --no-cache-dir -c /tmp/requirements.lock jax
 
 WORKDIR /app
-COPY pyproject.toml constraints.txt ./
+COPY pyproject.toml requirements.lock ./
 COPY kubernetes_deep_learning_tpu ./kubernetes_deep_learning_tpu
-# constraints.txt pins exact versions (the reference's Pipfile.lock role).
-RUN pip install --no-cache-dir -c constraints.txt ".[grpc]"
+# requirements.lock pins the full transitive closure (the reference's
+# Pipfile.lock role).
+RUN pip install --no-cache-dir -c requirements.lock ".[grpc]"
 
 # Versioned artifact layout /models/<name>/<version>/ -- the same convention
 # the reference bakes its SavedModel with (tf-serving.dockerfile:5).

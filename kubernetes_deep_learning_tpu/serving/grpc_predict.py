@@ -201,6 +201,62 @@ class PredictionServicer:
                     model=request.model_spec.name,
                 )
 
+    def GetModelMetadata(self, request, context):
+        """TF-Serving's signature-discovery RPC: the ModelSpec-derived
+        serving_default signature, in the binary's exact response shape
+        (SignatureDefMap packed in Any under metadata["signature_def"]).
+        Replaces round 2's UNIMPLEMENTED; the reference's tier carries it
+        in the TF-Serving binary (reference tf-serving.dockerfile:2), and
+        it is how clients discover what reference model_server.py:40-47
+        hardcodes by hand."""
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow.core.protobuf import (
+            meta_graph_pb2,
+        )
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+            get_model_metadata_pb2,
+        )
+
+        name = request.model_spec.name
+        model = self._server.models.get(name)
+        if model is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"Servable not found for request: Latest({name})",
+            )
+        fields = list(request.metadata_field) or ["signature_def"]
+        if fields != ["signature_def"]:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"Metadata field {fields} not supported",
+            )
+        spec = model.artifact.spec
+
+        def tensor_info(tname: str, shape: tuple) -> meta_graph_pb2.TensorInfo:
+            ti = meta_graph_pb2.TensorInfo()
+            ti.name = f"{tname}:0"
+            ti.dtype = 1  # DataType.DT_FLOAT (types.proto)
+            dims = ti.tensor_shape.dim
+            for s in shape:
+                dims.add().size = s
+            return ti
+
+        sig = meta_graph_pb2.SignatureDef()
+        sig.method_name = "tensorflow/serving/predict"
+        in_name = spec.compat_input_name or spec.input_name
+        out_name = spec.compat_output_name or spec.output_name
+        sig.inputs[in_name].CopyFrom(tensor_info(in_name, (-1, *spec.input_shape)))
+        sig.outputs[out_name].CopyFrom(
+            tensor_info(out_name, (-1, spec.num_classes))
+        )
+        sdmap = get_model_metadata_pb2.SignatureDefMap()
+        sdmap.signature_def["serving_default"].CopyFrom(sig)
+
+        resp = get_model_metadata_pb2.GetModelMetadataResponse()
+        resp.model_spec.name = name
+        resp.model_spec.version.value = model.version
+        resp.metadata["signature_def"].Pack(sdmap)
+        return resp
+
     def _predict(self, request):
         from kubernetes_deep_learning_tpu.serving.model_server import (
             MAX_IMAGES_PER_REQUEST,
@@ -279,11 +335,20 @@ def add_to_server(servicer: PredictionServicer, grpc_server: grpc.Server) -> Non
     gRPC routes on the literal path /tensorflow.serving.PredictionService/
     Predict.
     """
+    from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+        get_model_metadata_pb2,
+    )
+
     handlers = {
         "Predict": grpc.unary_unary_rpc_method_handler(
             servicer.Predict,
             request_deserializer=predict_pb2.PredictRequest.FromString,
             response_serializer=predict_pb2.PredictResponse.SerializeToString,
+        ),
+        "GetModelMetadata": grpc.unary_unary_rpc_method_handler(
+            servicer.GetModelMetadata,
+            request_deserializer=get_model_metadata_pb2.GetModelMetadataRequest.FromString,
+            response_serializer=get_model_metadata_pb2.GetModelMetadataResponse.SerializeToString,
         ),
     }
     grpc_server.add_generic_rpc_handlers(

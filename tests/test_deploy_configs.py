@@ -105,3 +105,42 @@ def test_compose_services_reference_built_dockerfiles():
             ctx = os.path.normpath(os.path.join(DEPLOY, build.get("context", ".")))
             path = os.path.join(ctx, build["dockerfile"])
             assert os.path.exists(path), f"compose references missing {path}"
+
+
+def test_lockfile_consistent_with_constraints():
+    """requirements.lock (the Pipfile.lock-equivalent transitive closure)
+    must agree with constraints.txt's direct pins and cover the runtime
+    dependency roots -- images install from the lock (deploy/*.dockerfile)."""
+    import re
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def pins(path):
+        out = {}
+        for line in open(os.path.join(root, path)):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, _, ver = line.partition("==")
+            out[re.sub(r"[-_.]+", "-", name).lower()] = ver
+        return out
+
+    constraints = pins("constraints.txt")
+    lock = pins("requirements.lock")
+    assert len(lock) >= 40, f"suspiciously small lock ({len(lock)} pins)"
+    for name, ver in constraints.items():
+        assert name in lock, f"{name} pinned in constraints.txt but not locked"
+        assert lock[name] == ver, (
+            f"{name}: constraints.txt=={ver} but requirements.lock=={lock[name]}"
+        )
+    for direct in ("jax", "flax", "numpy", "msgpack", "pillow", "requests",
+                   "optax", "grpcio", "protobuf", "gunicorn"):
+        assert direct in lock, f"runtime root {direct} missing from lock"
+
+
+def test_dockerfiles_install_from_lock():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for df in ("deploy/gateway.dockerfile", "deploy/model-server.dockerfile"):
+        text = open(os.path.join(root, df)).read()
+        assert "requirements.lock" in text, f"{df} does not use the lockfile"
+        assert "-c requirements.lock" in text or "-c /tmp/requirements.lock" in text

@@ -67,7 +67,7 @@ def grpc_stack(tmp_path_factory):
         request_serializer=predict_pb2.PredictRequest.SerializeToString,
         response_deserializer=predict_pb2.PredictResponse.FromString,
     )
-    yield spec, server, predict
+    yield spec, server, predict, channel
 
     channel.close()
     grpc_server.stop(grace=None)
@@ -85,7 +85,7 @@ def _reference_style_request(spec, X: np.ndarray) -> predict_pb2.PredictRequest:
 
 
 def test_reference_client_marshalling_roundtrip(grpc_stack):
-    spec, server, predict = grpc_stack
+    spec, server, predict, _ = grpc_stack
     rng = np.random.default_rng(0)
     # The reference gateway sends preprocessed float32 ("tf" mode: [-1, 1]).
     X = rng.uniform(-1.0, 1.0, size=(1, *spec.input_shape)).astype(np.float32)
@@ -109,7 +109,7 @@ def test_reference_client_marshalling_roundtrip(grpc_stack):
 
 def test_uint8_content_and_shapes(grpc_stack):
     """uint8 wire path (this framework's preferred dtype) over gRPC."""
-    spec, server, predict = grpc_stack
+    spec, server, predict, _ = grpc_stack
     rng = np.random.default_rng(1)
     images = rng.integers(0, 256, size=(3, *spec.input_shape), dtype=np.uint8)
     req = predict_pb2.PredictRequest()
@@ -126,7 +126,7 @@ def test_uint8_content_and_shapes(grpc_stack):
 
 def test_float_val_and_broadcast_marshalling(grpc_stack):
     """Packed float_val requests and the single-element broadcast convention."""
-    spec, server, predict = grpc_stack
+    spec, server, predict, _ = grpc_stack
     rng = np.random.default_rng(2)
     X = rng.uniform(-1, 1, size=(1, *spec.input_shape)).astype(np.float32)
     req = _reference_style_request(spec, X)
@@ -155,7 +155,7 @@ def test_int32_pixels_normalize_like_uint8(grpc_stack):
     """Integer tensors are pixels: they must take the normalize-on-device
     path, not be misread as pre-normalized floats (tf.make_tensor_proto
     emits DT_INT32 for plain Python int lists)."""
-    spec, server, predict = grpc_stack
+    spec, server, predict, _ = grpc_stack
     rng = np.random.default_rng(5)
     pixels = rng.integers(0, 256, size=(1, *spec.input_shape), dtype=np.int32)
     req = predict_pb2.PredictRequest()
@@ -174,7 +174,7 @@ def test_int32_pixels_normalize_like_uint8(grpc_stack):
 
 
 def test_grpc_error_statuses(grpc_stack):
-    spec, _, predict = grpc_stack
+    spec, _, predict, _ = grpc_stack
     rng = np.random.default_rng(3)
     X = rng.uniform(-1, 1, size=(1, *spec.input_shape)).astype(np.float32)
 
@@ -231,3 +231,45 @@ def test_modelspec_compat_fields_roundtrip():
 
     old = ModelSpec.from_json(_json.dumps(legacy))
     assert old.compat_input_name == "" and old.compat_output_name == ""
+
+
+def test_get_model_metadata_signature(grpc_stack):
+    """TF-Serving's GetModelMetadata (round-2 gap: UNIMPLEMENTED): the
+    response must carry the ModelSpec-derived serving_default signature in
+    the binary's exact shape -- SignatureDefMap packed in an Any under
+    metadata["signature_def"], compat tensor names, -1 batch dims."""
+    from kubernetes_deep_learning_tpu.serving.grpc_predict import SERVICE_NAME
+    from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+        get_model_metadata_pb2,
+    )
+
+    spec, server, _, channel = grpc_stack
+    call = channel.unary_unary(
+        f"/{SERVICE_NAME}/GetModelMetadata",
+        request_serializer=get_model_metadata_pb2.GetModelMetadataRequest.SerializeToString,
+        response_deserializer=get_model_metadata_pb2.GetModelMetadataResponse.FromString,
+    )
+    req = get_model_metadata_pb2.GetModelMetadataRequest()
+    req.model_spec.name = spec.name
+    req.metadata_field.append("signature_def")
+    resp = call(req, timeout=30)
+    assert resp.model_spec.name == spec.name
+    assert resp.model_spec.version.value == 1
+    packed = resp.metadata["signature_def"]
+    assert packed.type_url.endswith("tensorflow.serving.SignatureDefMap")
+    sdmap = get_model_metadata_pb2.SignatureDefMap()
+    assert packed.Unpack(sdmap)
+    sig = sdmap.signature_def["serving_default"]
+    assert sig.method_name == "tensorflow/serving/predict"
+    info = sig.inputs["input_8"]  # the reference's compat tensor name
+    assert info.name == "input_8:0" and info.dtype == 1
+    assert [d.size for d in info.tensor_shape.dim] == [-1, 96, 96, 3]
+    out = sig.outputs["dense_7"]
+    assert [d.size for d in out.tensor_shape.dim] == [-1, 4]
+
+    # unknown model -> NOT_FOUND, TF-Serving's wording
+    req2 = get_model_metadata_pb2.GetModelMetadataRequest()
+    req2.model_spec.name = "nope"
+    with pytest.raises(grpc.RpcError) as ei:
+        call(req2, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND

@@ -126,3 +126,80 @@ def test_served_data_parallel_over_mesh(shard_spec, artifact_root):
             np.testing.assert_allclose(got, direct[v], atol=5e-2)
     finally:
         server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def xc_spec() -> ModelSpec:
+    return register_spec(
+        ModelSpec(
+            name="shard-xc",
+            family="xception",
+            input_shape=(96, 96, 3),
+            labels=("a", "b", "c", "d"),
+            preprocessing="tf",
+            description="test-only sharded fused-fast-path model",
+        )
+    )
+
+
+def test_shard_map_fast_path_matches_flax(xc_spec, monkeypatch):
+    """The fused fast forward under shard_map (each chip runs the fused
+    Pallas program on its local batch shard -- what mesh serving runs on
+    TPU) vs the flax graph on identical variables.  Interpret mode stands
+    in for Mosaic on CPU; real-TPU engagement is covered by
+    resolve_sharded_fast + the engine wiring below."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import xception_fast
+    from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+        build_sharded_forward,
+    )
+
+    monkeypatch.setattr(
+        xception_fast,
+        "build_fast_forward",
+        functools.partial(xception_fast.build_fast_forward, interpret=True),
+    )
+    mesh = make_mesh(8)
+    variables = init_variables(xc_spec, seed=2)
+    call = build_sharded_forward(mesh=mesh, spec=xc_spec, dtype=jnp.bfloat16, fast=True)
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 256, size=(16, *xc_spec.input_shape), dtype=np.uint8)
+    got = np.asarray(call(variables, images))
+    want = np.asarray(
+        build_forward(xc_spec, dtype=jnp.bfloat16, fast=False)(variables, images)
+    )
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+    assert rel < 1e-2, f"shard_map fast path diverges: {rel:.2e}"
+
+
+def test_mesh_engine_fast_resolution_and_degrade(xc_spec, tmp_path):
+    """resolve_sharded_fast gates on platform/model-axis; a mesh engine
+    with the fast path FORCED on CPU reproduces a real Mosaic-style compile
+    failure under shard_map and must degrade to the flax graph fleet-wide,
+    same contract as single-device serving."""
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+        resolve_sharded_fast,
+    )
+
+    mesh = make_mesh(8)
+    # auto on a CPU mesh: exact graph (no Pallas on CPU outside interpret)
+    assert not resolve_sharded_fast(xc_spec, mesh, jnp.bfloat16, "auto")
+    # model axis > 1: exact graph even where fast would otherwise resolve
+    assert not resolve_sharded_fast(
+        xc_spec, make_mesh(8, model_parallel=2), jnp.bfloat16, True
+    )
+
+    export_model(xc_spec, init_variables(xc_spec, seed=1), str(tmp_path))
+    a = art.load_artifact(art.version_dir(str(tmp_path), xc_spec.name, 1))
+    eng = InferenceEngine(a, buckets=(8,), mesh=mesh, fast=True)
+    assert eng._fast_engaged
+    eng.warmup()
+    assert eng.ready and eng.fast_degraded
+    out = eng.predict(np.zeros((3, *xc_spec.input_shape), np.uint8))
+    assert out.shape == (3, 4)

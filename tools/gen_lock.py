@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Regenerate requirements.lock: the exact transitive dependency closure of
+the package's runtime/serve/grpc roots, resolved against the CURRENT
+environment (the one the test suite and benchmarks run under).
+
+The reference pins its whole transitive set in Pipfile.lock (46 packages,
+SURVEY.md component 15); this is the TPU stack's equivalent.  No hashes:
+this environment has no package egress to fetch archives to hash.
+"""
+
+from __future__ import annotations
+
+import re
+from importlib import metadata
+
+ROOTS = [
+    "jax", "flax", "numpy", "msgpack", "Pillow", "requests", "optax",
+    "gunicorn", "grpcio", "protobuf", "h5py", "pyyaml",
+    "orbax-checkpoint", "chex", "jaxlib",
+]
+
+HEADER = """\
+# requirements.lock -- full transitive dependency closure, exact versions.
+# The reference pins 46 transitive packages in Pipfile.lock (SURVEY.md
+# component 15); this is the equivalent for the TPU stack: every package
+# reachable from the runtime/serve/grpc dependency roots, resolved against
+# the environment the test suite and benchmarks run under.  No hashes: the
+# build environment has no package egress to compute them from; versions
+# are exact.  Regenerate with: python tools/gen_lock.py
+# Used by deploy/*.dockerfile as the pip constraints file.
+"""
+
+
+def main() -> None:
+    seen: dict[str, tuple[str, str]] = {}
+
+    def norm(n: str) -> str:
+        return re.sub(r"[-_.]+", "-", n).lower()
+
+    def visit(name: str) -> None:
+        n = norm(name)
+        if n in seen:
+            return
+        try:
+            dist = metadata.distribution(name)
+        except metadata.PackageNotFoundError:
+            return
+        seen[n] = (dist.metadata["Name"], dist.version)
+        for req in dist.requires or []:
+            if "extra ==" in req:  # extras-gated: not part of the closure
+                continue
+            m = re.match(r"^\s*([A-Za-z0-9_.\-]+)", req)
+            if m:
+                visit(m.group(1))
+
+    for r in ROOTS:
+        visit(r)
+    # Roots not installed in THIS env (e.g. gunicorn lives only in the
+    # gateway image) fall back to constraints.txt's explicit pin.
+    constraints = {}
+    for line in open("constraints.txt"):
+        line = line.strip()
+        if line and not line.startswith("#") and "==" in line:
+            n, _, v = line.partition("==")
+            constraints[norm(n)] = (n, v)
+    for r in ROOTS:
+        if norm(r) not in seen:
+            if norm(r) not in constraints:
+                raise SystemExit(f"root {r} neither installed nor in constraints.txt")
+            seen[norm(r)] = constraints[norm(r)]
+    lines = sorted(f"{name}=={ver}" for name, ver in seen.values())
+    with open("requirements.lock", "w") as f:
+        f.write(HEADER + "\n".join(lines) + "\n")
+    print(f"{len(lines)} packages locked")
+
+
+if __name__ == "__main__":
+    main()
