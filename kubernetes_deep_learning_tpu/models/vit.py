@@ -76,14 +76,17 @@ class SelfAttention(nn.Module):
         k = proj("key")(x).transpose(0, 2, 1, 3)
         v = proj("value")(x).transpose(0, 2, 1, 3)
 
-        block = attention.pick_block(s)
         if train:
             # Differentiable memory-efficient path: flash forward (on TPU)
             # with the blockwise-recompute backward -- O(S * block)
-            # activations, so long sequences fine-tune without the (S, S)
-            # score matrix ever landing in HBM.
+            # activations for block-tileable S (all registered specs: the
+            # cls-token-free design keeps S = the patch grid).  Ragged S
+            # still falls back to the einsum reference INSIDE
+            # attention_trainable (the custom-vjp backward is not yet
+            # padded) -- inference is ragged-safe via
+            # flash_attention_padded, training is not.
             o = attention.attention_trainable(q, k, v)
-        elif block is None or not attention._HAVE_PALLAS:
+        elif not attention._HAVE_PALLAS:
             o = attention.mha_reference(q, k, v)
         else:
             # Resolve the kernel choice at LOWERING time, not trace time: the
@@ -91,6 +94,11 @@ class SelfAttention(nn.Module):
             # trace-time jax.devices() check would bake the wrong mode into
             # one of them (interpreted Pallas on CPU serving, or a
             # non-interpretable kernel in the CPU lowering).
+            # flash_attention_padded handles ANY token count: the
+            # registered specs tile exactly (no cls token, see module doc),
+            # and ragged grids (e.g. a 144x144 input -> 81 tokens) pad to
+            # the next 128-multiple with kv_len masking instead of
+            # silently dropping to the einsum reference.
             import functools
 
             import jax
@@ -100,10 +108,7 @@ class SelfAttention(nn.Module):
                 k,
                 v,
                 tpu=functools.partial(
-                    attention.flash_attention,
-                    block_q=block,
-                    block_k=block,
-                    interpret=False,
+                    attention.flash_attention_padded, interpret=False
                 ),
                 default=attention.mha_reference,
             )

@@ -43,9 +43,10 @@ def _causal_mask(q_offset: int, k_offset, block_q: int, block_k: int):
 def pick_block(seq: int) -> int | None:
     """Largest MXU-friendly flash block (<=128, 8-aligned) dividing ``seq``.
 
-    None means no legal tiling exists and callers must use the einsum path.
-    Single source of the kernel's tiling rule -- consumed by models.vit and
-    parallel.ring.
+    None means no legal tiling exists for ``seq`` AS IS; callers should go
+    through ``flash_attention_padded`` (pad + kv_len masking) rather than
+    falling back to the einsum path.  Single source of the kernel's tiling
+    rule -- consumed by flash_attention_padded and parallel.ring.
     """
     for block in (128, 64, 32, 16, 8):
         if seq % block == 0:
@@ -118,11 +119,15 @@ def finalize_partials(partial):
 # --- Pallas fused kernel ---------------------------------------------------
 
 
-def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset):
+def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset, kv_len=None):
     """One (1, block_q, d) query tile vs the local KV, online softmax.
 
     Returns the running ``(acc, m, l)`` carried state: unnormalized output,
     row max, and normalizer, each f32 with m/l shaped (block_q, 1).
+
+    ``kv_len``: number of VALID local kv rows (ragged sequences padded up
+    to a block multiple -- e.g. ViT's 257 tokens padded to 264); columns at
+    or beyond it are masked to -inf so pad keys never enter the softmax.
     """
     q = q_ref[0].astype(jnp.float32)          # (block_q, d)
     block_q, d = q.shape
@@ -140,6 +145,12 @@ def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset):
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                              # (block_q, block_k)
+        if kv_len is not None:
+            cols = (
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                + j * block_k
+            )
+            s = jnp.where(cols < kv_len, s, NEG_INF)
         if causal:
             mask = _causal_mask(q_start, j * block_k + k_offset, block_q, block_k)
             s = jnp.where(mask, s, NEG_INF)
@@ -170,10 +181,12 @@ def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset):
     return jax.lax.fori_loop(0, hi, body, (acc, m, l))
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset,
+                  kv_len=None):
     """Fused form: normalize in-kernel, write the attention output tile."""
     acc, m, l = _flash_body(
-        q_ref, k_ref, v_ref, block_k=block_k, causal=causal, k_offset=k_offset
+        q_ref, k_ref, v_ref, block_k=block_k, causal=causal, k_offset=k_offset,
+        kv_len=kv_len,
     )
     # A row masked across EVERY key (causal with k_offset pushing the whole
     # block into the future) ends with m still at NEG_INF and p=exp(0)=1
@@ -185,11 +198,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, k_offset):
 
 
 def _flash_kernel_partials(
-    q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *, block_k, causal, k_offset
+    q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *, block_k, causal, k_offset,
+    kv_len=None,
 ):
     """Partial form: write raw (acc, m, l) for cross-shard lse merging."""
     acc, m, l = _flash_body(
-        q_ref, k_ref, v_ref, block_k=block_k, causal=causal, k_offset=k_offset
+        q_ref, k_ref, v_ref, block_k=block_k, causal=causal, k_offset=k_offset,
+        kv_len=kv_len,
     )
     acc_ref[0] = acc
     m_ref[0] = m  # (block_q, 1): trailing singleton keeps Mosaic tiling legal
@@ -216,8 +231,14 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool | None = None,
     return_partials: bool = False,
+    kv_len: int | None = None,
 ):
     """Fused flash attention.  q, k, v: (B, H, S, D) -> (B, H, S, D).
+
+    ``kv_len``: valid kv rows when the sequences are PADDED to a block
+    multiple (ragged lengths, e.g. ViT's 257 tokens); pad keys are masked
+    out of the softmax.  See ``flash_attention_padded`` for the wrapper
+    that does the padding/slicing.
 
     The full local KV for one (batch, head) lives in VMEM while query tiles
     stream over it, so S_local * D must fit VMEM (~16 MB/core) -- e.g.
@@ -264,7 +285,8 @@ def flash_attention(
 
     if return_partials:
         kernel = functools.partial(
-            _flash_kernel_partials, block_k=block_k, causal=causal, k_offset=k_offset
+            _flash_kernel_partials, block_k=block_k, causal=causal,
+            k_offset=k_offset, kv_len=kv_len,
         )
         # (B*H, S, 1) with trailing singleton: Mosaic requires the last two
         # block dims be (8k, 128k)-divisible or equal to the array dims; a
@@ -293,7 +315,8 @@ def flash_attention(
         )
 
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, k_offset=k_offset
+        _flash_kernel, block_k=block_k, causal=causal, k_offset=k_offset,
+        kv_len=kv_len,
     )
     out = pl.pallas_call(
         kernel,
@@ -304,6 +327,38 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)
+
+
+def flash_attention_padded(q, k, v, *, causal: bool = False,
+                           interpret: bool | None = None):
+    """Flash attention for ANY sequence length: pads S up to the nearest
+    block multiple, masks the pad keys via ``kv_len``, slices the output.
+
+    Without this, a sequence with no 8-aligned divisor (ViT-B/16 at 256
+    squared has 257 tokens -- prime) silently fell back to the einsum
+    reference and materialized the (S, S) score matrix in HBM.  Pad-query
+    rows are zeros; their outputs are garbage-free (finite) and sliced off.
+    """
+    s = q.shape[2]
+    block = pick_block(s)
+    if block is not None:
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block, block_k=block,
+            interpret=interpret,
+        )
+    # Pad to a multiple of 128, NOT the minimal 8: pick_block(next-8-
+    # multiple) would tile the MXU at 8x8 for most ragged lengths (e.g.
+    # 257 -> 264 -> block 8), wasting ~15/16 of every pass.  The extra pad
+    # rows are masked by kv_len and cost <=127 rows of FLOPs.
+    sp = -(-s // 128) * 128
+    block = pick_block(sp)
+    pad = ((0, 0), (0, 0), (0, sp - s), (0, 0))
+    out = flash_attention(
+        jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+        causal=causal, block_q=block, block_k=block,
+        interpret=interpret, kv_len=s,
+    )
+    return out[:, :, :s, :]
 
 
 # --- trainable memory-efficient attention ----------------------------------

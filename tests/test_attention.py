@@ -156,3 +156,24 @@ def test_fully_masked_block_is_neutral_in_merge():
     got = finalize_partials(combine_partials(real, future))
     want = finalize_partials(real)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+@pytest.mark.parametrize("seq", [257, 13, 100])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_padded_ragged_seq(seq, causal):
+    """Ragged sequence lengths (no 8-aligned divisor, e.g. ViT's prime 257
+    tokens) run the flash kernel via pad + kv_len masking and must match
+    the einsum reference exactly on the real rows."""
+    from kubernetes_deep_learning_tpu.ops.attention import (
+        flash_attention_padded,
+        mha_reference,
+    )
+
+    rng = np.random.default_rng(seq)
+    shape = (2, 3, seq, 16)
+    q = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    k = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+    got = np.asarray(flash_attention_padded(q, k, v, causal=causal))
+    want = np.asarray(mha_reference(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
