@@ -141,17 +141,17 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
         x = jax.device_put(
             rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
         )
-        # Auto-size the iteration count so the dev tunnel's ~70 ms dispatch
-        # RTT is amortized to a ~1-2% effect: at the old fixed 30
-        # iterations it added ~2.3 ms/iteration to BOTH methods (round-3
-        # finding: the device stream was packed -- trace span 13.8 ms/iter
-        # at batch 64 -- while the bench reported 16.6).  A short pipelined
-        # probe estimates the warm per-iteration time, then k targets ~7 s
-        # per timed call (one RTT / 7 s = 1%; the probe's own RTT share
-        # inflates the estimate slightly, so the bound is ~1-2% at batch 1
-        # and tighter for bigger batches).  Production PCIe dispatch is
-        # tens of us, so the RTT is a harness artifact, not serving cost;
-        # the two-method agreement check still applies.
+        # Auto-size the CHAINED-SCAN iteration count so the dev tunnel's
+        # ~70 ms dispatch RTT amortizes to a ~1-2% effect on that method:
+        # at the old fixed 30 iterations it added ~2.3 ms/iteration
+        # (round-3 finding: the device stream was packed -- trace span
+        # 13.8 ms/iter at batch 64 -- while the bench reported 16.6).  A
+        # short pipelined probe estimates the warm per-iteration time,
+        # then k targets ~7 s per timed scan call.  The PIPELINED method
+        # is separately burst-capped below and keeps a larger residual at
+        # tiny batches.  Production PCIe dispatch is tens of us, so the
+        # RTT is a harness artifact, not serving cost; the two-method
+        # agreement check still applies.
         jax.block_until_ready(fwd_jit(variables, x))  # compile/warm this shape
         if scan_len:
             k = scan_len
@@ -607,7 +607,9 @@ def main() -> int:
     # bound on v5e; 256/1024 probe the unbound throughput ceiling.
     p.add_argument("--batches", default="1,2,4,8,16,32,48,56,64,128,256,1024")
     p.add_argument("--scan-len", type=int, default=0,
-                   help="fwd passes per timed call (0 = auto-size per batch to amortize dispatch RTT)")
+                   help="fwd passes per timed chained-scan call (0 = auto-size "
+                        "per batch to amortize dispatch RTT); the pipelined "
+                        "method's burst is always capped at 200 dispatches")
     p.add_argument("--reps", type=int, default=5, help="timed calls per batch size")
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument(
