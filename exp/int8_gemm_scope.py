@@ -65,12 +65,12 @@ def main() -> None:
                 times.append((time.perf_counter() - t0) / k)
             us = float(np.median(times)) * 1e6
             flops = 2 * M * C * C
-            rate = flops / us / 1e6
-            peak = 394e3 if name == "int8" else 197e3  # GFLOP/s, v5e
-            flag = "  IMPOSSIBLE(>peak)" if rate > peak else ""
+            tflops = flops / (us * 1e-6) / 1e12  # FLOP / s -> TFLOP/s
+            peak = 394.0 if name == "int8" else 197.0  # TFLOP/s (TOPS), v5e
+            flag = "  IMPOSSIBLE(>peak)" if tflops > peak else ""
             results[name] = us
             print(f"  bt={bt:3d} {name}: {us:8.2f} us/GEMM "
-                  f"({rate:7.1f} GFLOP/s-equiv, {rate/peak*100:4.1f}% peak){flag}")
+                  f"({tflops:6.1f} TFLOP/s, {tflops/peak*100:5.1f}% peak){flag}")
         print(f"  bt={bt:3d} int8 speedup: {results['bf16']/results['int8']:.2f}x")
 
 
