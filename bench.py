@@ -25,6 +25,12 @@ within the p50<=15 ms bound, and the JSON self-flags impossibility: it
 reports MFU = img/s x FLOPs/image / device peak, computed from XLA's own
 cost analysis.  MFU > 100% means the measurement is wrong, by construction.
 
+Fault isolation: each batch point runs in its OWN subprocess
+(run_isolated_sweep), so a TPU worker crash -- which nullified the official
+record in rounds 1-3 by killing the single shared process -- costs exactly
+one point: it is retried once, recorded in the JSON's "faults" list, and
+the headline comes from the surviving points.
+
 Prints ONE JSON line to stdout:
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
      "mfu_pct": N}
@@ -35,6 +41,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 from functools import partial
@@ -89,8 +97,94 @@ def compiled_flops_per_image(jitted, batch: int, *example_args) -> float | None:
         return None
 
 
+def trace_span_stats(fwd_jit, variables, x, k):
+    """Third estimator: per-iteration DEVICE time from jax.profiler spans.
+
+    Dispatches ``k`` independent forwards in one pipelined burst under a
+    profiler trace and reads the device stream's own timeline -- immune to
+    this machine's ~70 ms tunnel dispatch RTT, which depresses the
+    pipelined method at small batches (round-3 agreement 0.55-0.76 there).
+    Iterations are split at recurrences of the stream's first op name (one
+    jit program executes at a time on a TPU core, so per-iteration spans
+    do not overlap); if the split does not come out exact, only the
+    packed-stream mean is returned.  This also yields the only honest
+    device p99: the scan/pipelined methods time multi-iteration bursts,
+    and a percentile over burst MEANS structurally cannot see tail
+    latency.
+
+    Returns {p50_s, p99_s|None, mean_s, exact_iters} or None (no device
+    events -- e.g. CPU backend, where the profiler emits host events only).
+    """
+    import glob
+    import gzip
+    import shutil
+    import tempfile
+
+    import jax
+
+    trace_dir = tempfile.mkdtemp(prefix="kdlt-bench-trace-")
+    try:
+        np.asarray(fwd_jit(variables, x))  # keep compile out; real sync
+        with jax.profiler.trace(trace_dir):
+            outs = [fwd_jit(variables, x) for _ in range(k)]
+            jax.block_until_ready(outs)
+            np.asarray(outs[-1])  # force completion (lazy b_u_r on axon)
+        files = glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+        )
+        if not files:
+            return None
+        with gzip.open(files[0], "rt") as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        names = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                names[e["pid"]] = e["args"].get("name", "")
+        dev_pids = [
+            pid for pid, n in names.items()
+            if "TPU" in n or "/device" in n.lower()
+        ]
+        ops = [
+            e for e in events
+            if e.get("ph") == "X" and e.get("pid") in dev_pids
+            and e.get("dur", 0) > 0
+        ]
+        if not ops:
+            return None
+        by_tid: dict = {}
+        for e in ops:
+            by_tid.setdefault((e["pid"], e["tid"]), []).append(e)
+        evs = max(by_tid.values(), key=len)
+        evs.sort(key=lambda e: e["ts"])
+        span_s = (evs[-1]["ts"] + evs[-1]["dur"] - evs[0]["ts"]) / 1e6
+        starts = [i for i, e in enumerate(evs) if e["name"] == evs[0]["name"]]
+        if len(starts) != k:
+            return {
+                "p50_s": span_s / k, "p99_s": None, "mean_s": span_s / k,
+                "exact_iters": False,
+            }
+        bounds = starts + [len(evs)]
+        iters_s = []
+        for a, b in zip(bounds, bounds[1:]):
+            t1 = max(e["ts"] + e["dur"] for e in evs[a:b])
+            iters_s.append((t1 - evs[a]["ts"]) / 1e6)
+        arr = np.array(iters_s)
+        return {
+            "p50_s": float(np.percentile(arr, 50)),
+            "p99_s": float(np.percentile(arr, 99)),
+            "mean_s": float(arr.mean()),
+            "exact_iters": True,
+        }
+    except Exception as e:  # noqa: BLE001 - the estimator is best-effort
+        log(f"trace-span estimator unavailable: {e!r}")
+        return None
+    finally:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+
 def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_name,
-                  peak_override=0.0):
+                  peak_override=0.0, flops_img_known=0.0):
     import jax
     import jax.numpy as jnp
 
@@ -136,7 +230,7 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
         p = peak_tflops(dev, dtype_name)
         peak = p * 1e12 if p else None
     results = {}
-    flops_img = None
+    flops_img = flops_img_known or None
     for b in batch_sizes:
         x = jax.device_put(
             rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
@@ -193,37 +287,81 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
         # residual RTT share at 200 is ~0.5 ms/iter -- conservative
         # (min-of-methods direction) at tiny batches, <5% at batch >=48.
         kp = min(k, 200)
-        jax.block_until_ready(fwd_jit(variables, x))  # warm this shape
+        np.asarray(fwd_jit(variables, x))  # warm + sync this shape.  NB: a
+        # real TRANSFER, not block_until_ready -- on the axon tunnel,
+        # block_until_ready is a no-op until the first device->host
+        # transfer initializes the data plane (exp/worker_fault_probe.py
+        # finding); the scan method above always materializes first, but
+        # this must not silently depend on method ordering.
         pipe_times = []
         for _ in range(reps):
             t0 = time.perf_counter()
             outs = [fwd_jit(variables, x) for _ in range(kp)]
             jax.block_until_ready(outs)
+            np.asarray(outs[-1])  # force completion even if b_u_r is lazy
             pipe_times.append((time.perf_counter() - t0) / kp)
         pipe_p50_ms = float(np.percentile(pipe_times, 50) * 1e3)
         pipe_img_s = b / float(np.median(pipe_times))
 
-        # Headline candidate: the conservative minimum of the two methods.
-        img_s = min(scan_img_s, pipe_img_s)
-        p50 = max(scan_p50_ms, pipe_p50_ms)
-        agree = min(scan_img_s, pipe_img_s) / max(scan_img_s, pipe_img_s)
+        # Method 3: profiler trace spans -- per-iteration device time read
+        # off the device's own timeline (RTT-immune; see trace_span_stats).
+        tr = trace_span_stats(
+            fwd_jit, variables, x, k=min(100, max(20, 3000 // b))
+        )
+        trace_img_s = (b / tr["mean_s"]) if tr else None
+        trace_p50_ms = tr["p50_s"] * 1e3 if tr else None
+
+        # Headline candidate: conservative minimum of two INDEPENDENT
+        # methods.  The pipelined method carries ~0.5 ms/iter of residual
+        # tunnel RTT at tiny batches (burst cap note above), so when it
+        # disagrees with the scan by >10% the cross-check pairs the scan
+        # with the trace-span method instead (VERDICT r3 #6: the promised
+        # two-method bind did not actually bind below batch 8).
+        pipe_agree = min(scan_img_s, pipe_img_s) / max(scan_img_s, pipe_img_s)
+        if pipe_agree >= 0.9 or trace_img_s is None:
+            img_s = min(scan_img_s, pipe_img_s)
+            p50 = max(scan_p50_ms, pipe_p50_ms)
+            agree, methods = pipe_agree, "scan/pipelined"
+        else:
+            img_s = min(scan_img_s, trace_img_s)
+            p50 = max(scan_p50_ms, trace_p50_ms)
+            agree = min(scan_img_s, trace_img_s) / max(scan_img_s, trace_img_s)
+            methods = "scan/trace"
+        # Device p99 comes from per-iteration trace spans (the only honest
+        # tail estimate here: the scan/pipelined methods time bursts, and a
+        # percentile over burst MEANS cannot see tail latency).  Absent an
+        # exact span split, p99 is null rather than a fake.
+        p99 = tr["p99_s"] * 1e3 if tr and tr["p99_s"] is not None else None
         mfu = (img_s * flops_img / peak) if (peak and flops_img) else None
         results[b] = {
             "img_per_s": float(img_s),
             "scan_img_per_s": float(scan_img_s),
             "pipelined_img_per_s": float(pipe_img_s),
+            "trace_img_per_s": float(trace_img_s) if trace_img_s else None,
             "method_agreement": float(agree),
+            "headline_methods": methods,
             "p50_ms": p50,
+            # p50 is the conservative cross-method max; the trace method's
+            # own p50 accompanies the trace-derived p99 so the tail can be
+            # read against a like-for-like median (p99 may sit below the
+            # cross-method p50 -- that is the other method's overhead, not
+            # a statistics bug).
+            "trace_p50_ms": trace_p50_ms,
+            "p99_ms": p99,
+            "p99_source": "device-trace-span" if p99 is not None else None,
             "best_ms": float(min(per_step.min(), min(pipe_times)) * 1e3),
             "worst_ms": float(max(per_step.max(), max(pipe_times)) * 1e3),
             "compile_s": float(compile_s),
             "mfu_pct": round(mfu * 100, 1) if mfu is not None else None,
         }
         mfu_s = f"  MFU {results[b]['mfu_pct']:5.1f}%" if mfu is not None else ""
+        tr_s = f"{trace_img_s:.0f}" if trace_img_s else "n/a"
+        p99_s = f"{p99:7.2f}" if p99 is not None else "    n/a"
         log(
             f"batch {b:4d}: {img_s:9.1f} img/s (scan {scan_img_s:.0f} / "
-            f"pipelined {pipe_img_s:.0f}, agree {agree:.2f})  p50 {p50:7.2f} ms"
-            f"{mfu_s}  (compile {compile_s:.1f}s)"
+            f"pipelined {pipe_img_s:.0f} / trace {tr_s}; {methods} "
+            f"agree {agree:.2f})  p50 {p50:7.2f} ms  p99 {p99_s} ms{mfu_s}"
+            f"  (compile {compile_s:.1f}s)"
         )
         if mfu is not None and mfu > 1.0:
             log(
@@ -231,6 +369,193 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
                 "is physically impossible and will be excluded from the headline"
             )
     return spec, results, flops_img
+
+
+def run_isolated_sweep(args, batch_sizes):
+    """Run each batch point of the forward sweep in its OWN subprocess.
+
+    Round-3 postmortem (BENCH_r03.json): the TPU worker process died with a
+    "kernel fault" at one batch point, and because all 12 points shared one
+    process the whole official record was nullified -- for the third round
+    running.  Per-point isolation bounds the blast radius of any single
+    fault to that point: the crash is recorded as ``{"fault": ...}`` with
+    the child's stderr tail, the sweep continues, and the headline comes
+    from surviving points.  A faulted point is retried once after a pause
+    (the tunnel worker restarts itself); both attempts are recorded.
+
+    Returns (results, faults, flops_img).
+    """
+    results: dict[int, dict] = {}
+    faults: list[dict] = []
+    flops_img = 0.0
+    for b in batch_sizes:
+        row = None
+        for attempt in (1, 2):
+            cmd = [
+                sys.executable, os.path.abspath(__file__),
+                "--child-batch", str(b),
+                "--model", args.model,
+                "--scan-len", str(args.scan_len),
+                "--reps", str(args.reps),
+                "--dtype", args.dtype,
+                "--params-dtype", args.params_dtype,
+                "--peak-tflops", str(args.peak_tflops),
+            ]
+            if flops_img:
+                cmd += ["--flops-img", repr(flops_img)]
+            fault_msg = None
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+            )
+            try:
+                out_b, err_b = proc.communicate(timeout=args.point_timeout)
+                timed_out = False
+            except subprocess.TimeoutExpired:
+                # SIGTERM first, grace, then SIGKILL: a hard kill mid-compile
+                # can wedge the single-client TPU tunnel (verify SKILL.md).
+                proc.terminate()
+                try:
+                    out_b, err_b = proc.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    out_b, err_b = proc.communicate()
+                timed_out = True
+            stderr_text = (err_b or b"").decode(errors="replace")
+            if stderr_text:
+                sys.stderr.write(stderr_text)
+                sys.stderr.flush()
+            if timed_out:
+                fault_msg = (
+                    f"timeout after {args.point_timeout:.0f}s: "
+                    + stderr_text.strip()[-200:]
+                )
+            elif proc.returncode != 0:
+                fault_msg = (
+                    f"child exited rc={proc.returncode}: "
+                    + stderr_text.strip()[-400:]
+                )
+            else:
+                last = (out_b or b"").decode(errors="replace").strip().splitlines()
+                try:
+                    payload = json.loads(last[-1]) if last else {}
+                    row = payload["row"]
+                    flops_img = payload.get("flops_img") or flops_img
+                except (json.JSONDecodeError, KeyError, IndexError) as e:
+                    fault_msg = f"child rc=0 but unparsable output ({e!r})"
+            if row is not None:
+                break
+            log(f"batch {b:4d}: FAULT (attempt {attempt}/2): {fault_msg}")
+            faults.append({"batch": b, "attempt": attempt, "fault": fault_msg})
+            if attempt == 1:
+                # Let the TPU worker restart before retrying; a worker
+                # crash ("kernel fault") leaves the tunnel recovering for
+                # substantially longer than an ordinary child error.
+                pause = 90.0 if "crashed or restarted" in (fault_msg or "") else 10.0
+                time.sleep(pause)
+        if row is not None:
+            results[b] = row
+    return results, faults, flops_img
+
+
+def bench_soak(duration_s, model, buckets):
+    """Reliability soak: drive the REAL serving engine (fused fast path and
+    all) across every bucket repeatedly for ``duration_s`` seconds,
+    counting completed batches and faults.
+
+    Round-3 postmortem: the TPU worker "kernel fault" was twice written off
+    as transient with zero soak evidence anywhere in the repo (VERDICT r3
+    weak-1); the k8s liveness probe silently depends on the engine NOT
+    faulting under sustained bucket-ladder traffic.  This converts "not
+    reproducible" into a measured rate.  A faulting predict is recorded and
+    the soak CONTINUES (the next predict tells us whether the worker
+    recovered); 5 consecutive faults aborts the run as wedged.
+
+    Per-predict latency here includes this machine's ~70 ms tunnel dispatch
+    RTT (a production pod's PCIe dispatch is tens of us), so the value of
+    the p50/p99 columns is drift detection, not absolute latency; the fault
+    count is the headline.  Prints the one-line JSON and returns rc 0 only
+    for a fault-free soak.
+    """
+    import tempfile
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+    from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
+
+    spec = get_spec(model)
+    root = tempfile.mkdtemp(prefix="kdlt-soak-")
+    art.save_artifact(
+        art.version_dir(root, spec.name, 1), spec,
+        init_variables(spec, seed=0), None, {"compute_dtype": "bfloat16"},
+    )
+    artifact = art.load_artifact(art.version_dir(root, spec.name, 1))
+    engine = InferenceEngine(artifact, buckets=buckets)
+    log(f"soak: warming {len(buckets)} buckets ({buckets})...")
+    warm_s = engine.warmup()
+    log(f"soak: warmup {warm_s:.1f}s, fast_degraded={engine.fast_degraded}; "
+        f"running {duration_s:.0f}s")
+
+    rng = np.random.default_rng(0)
+    imgs = {
+        b: rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8)
+        for b in buckets
+    }
+    lat: dict[int, list] = {b: [] for b in buckets}
+    faults: list[dict] = []
+    consecutive = 0
+    images_done = 0
+    t_start = time.perf_counter()
+    while time.perf_counter() - t_start < duration_s:
+        for b in buckets:
+            t0 = time.perf_counter()
+            try:
+                out = engine.predict(imgs[b])
+                assert out.shape == (b, spec.num_classes)
+                lat[b].append(time.perf_counter() - t0)
+                images_done += b
+                consecutive = 0
+            except Exception as e:  # noqa: BLE001 - faults are the measurement
+                consecutive += 1
+                faults.append({
+                    "bucket": b,
+                    "t_s": round(time.perf_counter() - t_start, 1),
+                    "error": repr(e)[:300],
+                })
+                log(f"soak FAULT at bucket {b} "
+                    f"(t+{faults[-1]['t_s']}s, consecutive {consecutive}): {e!r}")
+                if consecutive >= 5:
+                    log("soak: 5 consecutive faults -- device wedged, aborting")
+                    break
+        if consecutive >= 5:
+            break
+    elapsed = time.perf_counter() - t_start
+    batches_done = sum(len(v) for v in lat.values())
+    for b in buckets:
+        a = np.array(lat[b]) * 1e3
+        if a.size:
+            log(f"  bucket {b:4d}: {a.size:6d} batches  p50 {np.percentile(a, 50):7.2f} ms  "
+                f"p99 {np.percentile(a, 99):7.2f} ms (incl. host dispatch+RTT)")
+    path = (
+        "degraded-exact" if engine.fast_degraded
+        else ("fused-fast" if engine._fast_engaged else "exact")
+    )
+    out = {
+        "metric": (
+            f"{spec.name} soak: batches completed across buckets {buckets} "
+            f"in {elapsed:.0f}s on {path} "
+            "engine (fault count is the reliability headline)"
+        ),
+        "value": batches_done,
+        "unit": "batches",
+        "vs_baseline": 1.0 if not faults else 0.0,
+        "images": images_done,
+        "elapsed_s": round(elapsed, 1),
+        "fault_count": len(faults),
+        "faults": faults,
+    }
+    print(json.dumps(out), flush=True)
+    return 0 if not faults else 1
 
 
 def bench_serving(duration_s, clients, batcher_impl, max_delay_ms, buckets):
@@ -650,7 +975,52 @@ def main() -> int:
         "--device-ms", default="0.5,1,2,5,10",
         help="simulated device ms/batch for --batcher-sweep",
     )
+    p.add_argument(
+        "--no-isolate", action="store_true",
+        help="run the whole forward sweep in THIS process instead of one "
+             "subprocess per batch point (faster on CPU; a device fault then "
+             "kills the whole sweep, see run_isolated_sweep)",
+    )
+    p.add_argument(
+        "--point-timeout", type=float, default=1200.0,
+        help="per-batch-point subprocess timeout (seconds); a hung point is "
+             "recorded as a fault and the sweep continues",
+    )
+    p.add_argument("--child-batch", type=int, default=0, help=argparse.SUPPRESS)
+    p.add_argument("--flops-img", type=float, default=0.0, help=argparse.SUPPRESS)
+    p.add_argument(
+        "--soak", type=float, default=0,
+        help="INSTEAD of the sweep: soak the real serving engine across "
+             "every bucket for this many seconds, counting faults "
+             "(reliability evidence for BENCH.md; rc=0 only if fault-free)",
+    )
+    p.add_argument(
+        "--soak-buckets", default="1,2,4,8,16,32,64,128",
+        help="bucket ladder for --soak (the engine default ladder)",
+    )
     args = p.parse_args()
+
+    if args.soak > 0:
+        return bench_soak(
+            args.soak, args.model,
+            tuple(int(b) for b in args.soak_buckets.split(",")),
+        )
+
+    if args.child_batch:
+        # Subprocess mode for run_isolated_sweep: bench ONE batch point and
+        # emit its row as the last stdout line.
+        spec, results, flops_img = bench_forward(
+            args.model, [args.child_batch], args.scan_len, args.reps,
+            args.dtype, args.params_dtype, args.peak_tflops,
+            flops_img_known=args.flops_img,
+        )
+        print(json.dumps({
+            "child": True,
+            "batch": args.child_batch,
+            "row": results[args.child_batch],
+            "flops_img": flops_img,
+        }), flush=True)
+        return 0
 
     if args.batcher_sweep > 0:
         bench_batcher_sweep(
@@ -681,15 +1051,33 @@ def main() -> int:
         )
 
     batch_sizes = [int(b) for b in args.batches.split(",")]
-    spec, results, flops_img = bench_forward(
-        args.model, batch_sizes, args.scan_len, args.reps, args.dtype,
-        args.params_dtype, args.peak_tflops,
-    )
+    if args.no_isolate:
+        _, results, flops_img = bench_forward(
+            args.model, batch_sizes, args.scan_len, args.reps, args.dtype,
+            args.params_dtype, args.peak_tflops,
+        )
+        faults = []
+    else:
+        results, faults, flops_img = run_isolated_sweep(args, batch_sizes)
+
+    if not results:
+        out = {
+            "metric": f"{args.model} images/sec/chip (EVERY batch point "
+            "faulted; no surviving measurements)",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "faults": faults,
+        }
+        print(json.dumps(out), flush=True)
+        return 1
 
     # Headline: the north star is ">=4000 img/s/chip at p50 <= 15 ms"
     # (BASELINE.json) -- the best MIN-of-both-methods throughput among batch
     # sizes that MEET the latency bound AND pass the physics check
-    # (MFU <= 100% when peak is known).  Full sweep is on stderr above.
+    # (MFU <= 100% when peak is known).  Full sweep is on stderr above and
+    # in the "sweep" field below; faulted points are in "faults" (nothing
+    # hidden -- a fault zeroes one point, not the record).
     def valid(r):
         return r["mfu_pct"] is None or r["mfu_pct"] <= 100.0
 
@@ -713,21 +1101,35 @@ def main() -> int:
             f"NO valid batch met the p50<={TARGET_P50_MS:.0f}ms bound; "
             "best valid overall"
         )
+    fault_note = f"; {len(faults)} faulted point attempt(s), see faults" if faults else ""
     out = {
-        "metric": f"{spec.name} images/sec/chip (best batch={headline_batch} "
-        f"{bound_note}; min of chained-scan/"
-        f"pipelined methods, agreement={r['method_agreement']:.2f}; device "
+        "metric": f"{args.model} images/sec/chip (best batch={headline_batch} "
+        f"{bound_note}; min of {r.get('headline_methods', 'scan/pipelined')} "
+        f"methods, agreement={r['method_agreement']:.2f}; device "
         f"p50={r['p50_ms']:.2f}ms/batch, {args.dtype} compute, "
         f"{args.params_dtype} params"
         + (f", {flops_img / 1e9:.2f} GFLOPs/img" if flops_img else "")
+        + fault_note
         + ")",
         "value": round(value, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(value / TARGET_IMG_S, 3),
         "mfu_pct": r["mfu_pct"],
+        "p50_ms": round(r["p50_ms"], 2),
+        "p99_ms": round(r["p99_ms"], 2) if r.get("p99_ms") is not None else None,
+        "sweep": {
+            str(b): {k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in row.items()}
+            for b, row in sorted(results.items())
+        },
+        "faults": faults,
     }
     print(json.dumps(out), flush=True)
-    return 0
+    # rc=0 iff the in-bound headline exists: a valid (physics-passing) batch
+    # met the latency bound and survived.  Faults at other points (e.g. the
+    # out-of-bound 256/1024 ceiling probes) are reported but do not nullify
+    # an in-bound record.
+    return 0 if (valid_pool and headline_batch in eligible) else 1
 
 
 if __name__ == "__main__":

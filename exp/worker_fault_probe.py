@@ -1,0 +1,126 @@
+"""Root-cause probe for the recurring TPU worker "kernel fault" (VERDICT
+r3 weak-1 / next-1b).
+
+Observed fact pattern (rounds 1-4): every crash happened inside the
+bench's CHAINED SCAN -- a single jit execution running ~7 s of
+back-to-back fused-kernel iterations (auto-k targets 7 s/call) -- never in
+pipelined bursts (many short executions), never in serving.  r3: batch-56
+point, ViT batch-256 sweep; r4: batch-32 point, twice, plus the batch-48
+first attempt while the worker was still recovering.
+
+Hypotheses, one phase per PROCESS (run each as
+``python exp/worker_fault_probe.py <phase>``; a fault kills only that
+process, and the driver shell inspects the exit):
+
+  pipelined      fused forward, 5 bursts x 200 dispatches (same total
+                 device work as one scan call, chopped into ~8 ms
+                 executions).  PASS expected if duration-per-execution is
+                 the trigger.
+  scan-short     chained scan k=100 (~1 s/execution), 8 calls.
+  scan-long      chained scan k=900 (~7 s/execution), 3 calls -- the
+                 bench's crashing configuration, minimally reproduced.
+  scan-long-96m  scan-long with the sepconv kernels' vmem_limit_bytes
+                 dropped 110 -> 96 MiB (hypothesis: near-limit VMEM).
+  scan-long-exact scan-long on the EXACT flax graph (no Pallas at all;
+                 k sized for ~7 s).  A fault here clears the kernels.
+
+Verdict key: if pipelined/scan-short PASS and scan-long FAULTS regardless
+of vmem/kernels, the trigger is sustained single-execution duration (a
+worker/tunnel watchdog), and the fix is capping the bench's per-execution
+scan length -- serving never runs multi-second executions, so the fault is
+a harness artifact, not a serving risk.  Results -> BENCH.md.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    phase = sys.argv[1]
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+
+    if phase == "scan-long-96m":
+        from jax.experimental.pallas import tpu as pltpu
+
+        from kubernetes_deep_learning_tpu.ops import fused_entry, fused_sepconv
+
+        params_cls = (
+            getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+        )
+        small = lambda: params_cls(vmem_limit_bytes=96 * 1024 * 1024)  # noqa: E731
+        fused_sepconv._compiler_params = small
+        fused_entry._entry_compiler_params = small
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    print(f"[{phase}] device {dev}", flush=True)
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    fast = phase != "scan-long-exact"
+    fwd = build_forward(spec, dtype=jnp.bfloat16, fast=True if fast else False)
+    fwd_jit = jax.jit(fwd)
+    rng = np.random.default_rng(0)
+    b = 32
+    x = jax.device_put(rng.integers(0, 256, (b, *spec.input_shape), np.uint8), dev)
+    jax.block_until_ready(fwd_jit(variables, x))
+    t0 = time.perf_counter()
+    jax.block_until_ready(fwd_jit(variables, x))
+    per = time.perf_counter() - t0
+    print(f"[{phase}] warm forward ~{per * 1e3:.1f} ms (incl. RTT)", flush=True)
+
+    @functools.partial(jax.jit, static_argnums=2)
+    def chained(v, xi, k):
+        def body(carry, _):
+            acc, xi = carry
+            s = fwd(v, xi).sum()
+            bit = jnp.signbit(s).astype(xi.dtype)
+            return (acc + s.astype(jnp.float32), xi ^ bit), None
+
+        (acc, _), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), xi), None, length=k
+        )
+        return acc
+
+    if phase == "pipelined":
+        for rep in range(5):
+            t0 = time.perf_counter()
+            outs = [fwd_jit(variables, x) for _ in range(200)]
+            jax.block_until_ready(outs)
+            # Materialize one result: forces real completion even if
+            # block_until_ready is lazy on this backend, and surfaces any
+            # async dispatch error as an exception (= the fault signal
+            # this probe exists to catch).
+            last = np.asarray(outs[-1])
+            assert np.isfinite(last).all()
+            dt = (time.perf_counter() - t0) / 200
+            print(f"[{phase}] burst {rep}: {dt * 1e3:.2f} ms/iter", flush=True)
+    elif phase in ("scan-short", "scan-long", "scan-long-96m", "scan-long-exact"):
+        k = 100 if phase == "scan-short" else 900
+        calls = 8 if phase == "scan-short" else 3
+        t0 = time.perf_counter()
+        float(chained(variables, x, k))
+        print(f"[{phase}] k={k} compile+first {time.perf_counter() - t0:.1f}s",
+              flush=True)
+        for rep in range(calls):
+            t0 = time.perf_counter()
+            float(chained(variables, x, k))
+            dt = time.perf_counter() - t0
+            print(f"[{phase}] call {rep}: {dt:.2f}s total, "
+                  f"{dt / k * 1e3:.2f} ms/iter", flush=True)
+    else:
+        raise SystemExit(f"unknown phase {phase}")
+    print(f"[{phase}] PASS", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
