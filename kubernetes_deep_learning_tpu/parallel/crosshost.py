@@ -21,9 +21,20 @@ This module supplies the missing coordination.  After
 Dispatch protocol (round 3 -- two-phase): each round broadcasts a tiny
 fixed-shape CONTROL pair ``(flag, aux)`` first, then a payload whose shape
 the control determined -- so the fleet supports a real bucket LADDER
-instead of round 2's single fixed dispatch shape, plus hot version reload:
+instead of round 2's single fixed dispatch shape, plus hot version reload.
+The aux value rides as two int32 words (exact to 2^62): version numbers
+are often unix timestamps -- second- or millisecond-resolution -- which
+float32 would round to a DIFFERENT existing version dir (silent
+mixed-version logits, ADVICE r3), int32 cannot represent, and int64 is
+silently canonicalized to int32 by JAX without x64 mode.
 
-- ``PREDICT``: aux = bucket; payload = the (bucket, H, W, C) uint8 batch.
+- ``PREDICT``/``PREDICT_FAST``: aux = bucket; payload = the (bucket, H, W,
+  C) uint8 batch.  The flag carries the fleet-wide execution mode: the
+  LEADER resolves fast vs exact once (AOT-probing the fused program's
+  compile on every bucket -- resolve_mode) and every round broadcasts the
+  decision, so a fused-path compile failure degrades the WHOLE fleet to
+  the exact graph in lockstep; a follower never discovers a Mosaic
+  failure mid-collective on its own.
 - ``RELOAD``:  aux = version; no payload.  Every process loads that version
   from its OWN model root (shared storage or identical image -- the same
   assumption boot-time loading already makes) and re-shards the variables.
@@ -51,7 +62,13 @@ import numpy as np
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
 
-_PREDICT, _SHUTDOWN, _RELOAD = 1.0, 0.0, 2.0
+_SHUTDOWN, _PREDICT, _RELOAD, _PREDICT_FAST = 0, 1, 2, 3
+
+# Watchdog slack for rounds that include a compile: the first round per
+# (mode, bucket) after an install traces+compiles the SPMD program (tens of
+# seconds to minutes on big models), which a flat round timeout would
+# misread as a dead peer -- exit(70) -> recompile -> crash loop (ADVICE r3).
+_COMPILE_TIMEOUT_FACTOR = 10.0
 
 
 def artifact_variables_for_sharding(artifact):
@@ -86,15 +103,23 @@ class CrossHostForward:
         model_root: str | None = None,
         model_name: str | None = None,
         round_timeout_s: float = 0.0,
+        fast: Any = "auto",
     ):
         """``buckets``: dispatch ladder; each entry is rounded up to a
         multiple of the data-axis size (0 = the axis size itself).
         ``model_root``/``model_name`` enable RELOAD (every process must see
         the same versioned artifact tree).  ``round_timeout_s`` > 0 arms
-        the leader's per-round watchdog (see module docstring)."""
+        the leader's per-round watchdog (see module docstring).  ``fast``
+        resolves per parallel.dataparallel.resolve_sharded_fast; when it
+        resolves, the leader AOT-probes the fused program at every bucket
+        and broadcasts fast/exact per round (module docstring)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+            resolve_sharded_fast,
+        )
 
         self.spec = spec
         self.mesh = mesh
@@ -107,6 +132,14 @@ class CrossHostForward:
         self.model_name = model_name
         self.round_timeout_s = round_timeout_s
         self.version: int | None = None
+        # Whether the fused fast path is statically possible on this mesh
+        # (same resolution on every process -- identical config).  The
+        # actual fleet mode is the LEADER's decision, carried per round in
+        # the control flag; followers build the fast program lazily on the
+        # first PREDICT_FAST round.
+        self._fast_possible = resolve_sharded_fast(spec, mesh, self._dtype, fast)
+        self.mode: str | None = "exact" if not self._fast_possible else None
+        self.fast_degraded = False
         # Serializes ALL leader rounds across every consumer of this
         # forward: during a hot reload the version watcher constructs a
         # fresh engine while the old one still serves, and a reload
@@ -144,11 +177,8 @@ class CrossHostForward:
             self._local_rows[b] = (start, stop)
 
     def _install_variables(self, variables: Any) -> None:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from kubernetes_deep_learning_tpu.models import build_forward
         from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+            build_sharded_jit,
             shard_variables,
         )
 
@@ -156,11 +186,62 @@ class CrossHostForward:
         # on every process because `variables` must be identical (same
         # artifact/seed) on every process.
         self._variables = shard_variables(variables, self.mesh)
-        # fast=False: see parallel.dataparallel (sharded batch dims).
-        forward = build_forward(self.spec, dtype=self._dtype, fast=False)
-        self._jitted = jax.jit(
-            forward, out_shardings=NamedSharding(self.mesh, P(DATA_AXIS))
+        self._jitted_exact = build_sharded_jit(
+            self.spec, self.mesh, self._dtype, fast=False
         )
+        self._jitted_fast = None  # built lazily (followers: first fast round)
+        self._fast_aot: dict = {}  # bucket -> AOT executable (leader probe)
+        # New jit instances -> every (mode, bucket) recompiles; the watchdog
+        # must re-apply first-round compile slack after a reload.
+        self._compiled_rounds: set = set()
+
+    def _fast_jitted(self):
+        if self._jitted_fast is None:
+            from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                build_sharded_jit,
+            )
+
+            self._jitted_fast = build_sharded_jit(
+                self.spec, self.mesh, self._dtype, fast=True
+            )
+        return self._jitted_fast
+
+    def resolve_mode(self) -> str:
+        """Leader: decide the fleet-wide execution mode ("fast"/"exact").
+
+        AOT-compiles the fused shard_map program for EVERY bucket before
+        any fast round is broadcast: compilation is process-local (no
+        collectives), so the leader can probe alone, and a Mosaic legality
+        failure at any bucket degrades the whole fleet to the exact graph
+        -- matching single-host serving's warmup degrade
+        (runtime.engine._degrade_fast) but decided once, fleet-wide,
+        BEFORE followers would trace the same program mid-round.
+        """
+        import jax
+
+        if self.mode is not None:
+            return self.mode
+        try:
+            fn = self._fast_jitted()
+            for b in self.buckets:
+                x = jax.ShapeDtypeStruct(
+                    (b, *self.spec.input_shape), np.uint8,
+                    sharding=self._batch_sharding,
+                )
+                self._fast_aot[b] = fn.lower(self._variables, x).compile()
+            self.mode = "fast"
+        except Exception as exc:  # noqa: BLE001 - compile errors vary by backend
+            import logging
+
+            logging.getLogger(__name__).error(
+                "cross-host fused fast-path compile FAILED; the fleet "
+                "serves the exact flax graph (fast=False). Cause: %s", exc,
+            )
+            self.fast_degraded = True
+            self._jitted_fast = None
+            self._fast_aot = {}
+            self.mode = "exact"
+        return self.mode
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -183,10 +264,20 @@ class CrossHostForward:
         bucket = self.bucket_for(n)
         pad = np.zeros((bucket - n, *self.spec.input_shape), np.uint8)
         batch = np.concatenate([images, pad])
-        with self._round_lock, self._watchdog("predict round"):
-            self._send_control(_PREDICT, float(bucket))
-            self._broadcast_payload(batch)
-            return self._run_round(batch)[:n]
+        with self._round_lock:
+            fast = self.resolve_mode() == "fast"
+            flag = _PREDICT_FAST if fast else _PREDICT
+            # First round per (mode, bucket) since install compiles on
+            # every process: widen the watchdog so a slow cold compile is
+            # not misread as a dead peer (ADVICE r3).
+            first = (fast, bucket) not in self._compiled_rounds
+            timeout = self.round_timeout_s * (_COMPILE_TIMEOUT_FACTOR if first else 1.0)
+            with self._watchdog("predict round", timeout):
+                self._send_control(flag, bucket)
+                self._broadcast_payload(batch)
+                out = self._run_round(batch, fast)[:n]
+            self._compiled_rounds.add((fast, bucket))
+            return out
 
     def reload(self, version: int, variables: Any = None) -> None:
         """Leader: hot-swap the fleet to artifact ``version``.
@@ -208,8 +299,15 @@ class CrossHostForward:
             raise RuntimeError("reload requires model_root/model_name")
         if variables is None:
             variables = self._load_version_variables(int(version))
-        with self._round_lock, self._watchdog(f"reload to v{version}"):
-            self._send_control(_RELOAD, float(version))
+        # Same slack as first-compile predict rounds: a RELOAD round makes
+        # every follower disk-load and re-shard the whole model inside the
+        # round, which a flat warm-round timeout would misread as a dead
+        # peer (exit 70 -> the watcher re-attempts -> crash loop).
+        with self._round_lock, self._watchdog(
+            f"reload to v{version}",
+            self.round_timeout_s * _COMPILE_TIMEOUT_FACTOR,
+        ):
+            self._send_control(_RELOAD, int(version))
             self._install_variables(variables)
             self.version = int(version)
 
@@ -219,7 +317,7 @@ class CrossHostForward:
 
         if jax.process_index() == 0:
             with self._round_lock:
-                self._send_control(_SHUTDOWN, 0.0)
+                self._send_control(_SHUTDOWN, 0)
 
     # --- follower (process > 0) ------------------------------------------
 
@@ -241,28 +339,49 @@ class CrossHostForward:
             if flag == _RELOAD:
                 self._do_reload(int(aux))
                 continue
+            fast = flag == _PREDICT_FAST
+            if fast and not self._fast_possible:
+                # The leader resolved "fast" where this process statically
+                # cannot build it: the fleet is misconfigured (mixed code
+                # or config versions).  Die loudly -> gang restart, rather
+                # than wedging the collective.
+                raise RuntimeError(
+                    "received PREDICT_FAST but the fused path does not "
+                    "resolve on this process; fleet config mismatch"
+                )
             batch = self._broadcast_payload(
                 np.zeros((int(aux), *self.spec.input_shape), np.uint8)
             )
-            self._run_round(batch)
+            self._run_round(batch, fast)
             rounds += 1
 
     # --- shared plumbing ---------------------------------------------------
 
-    def _send_control(self, flag: float, aux: float) -> None:
+    def _send_control(self, flag: int, aux: int) -> None:
+        # The aux rides as TWO int32 words (hi, lo base 2^31): exact for
+        # any plausible version number or bucket.  float32 would round
+        # timestamp-sized versions to a DIFFERENT dir (ADVICE r3); a
+        # single int32 cannot hold millisecond timestamps; and a plain
+        # int64 is NOT safe either -- without jax_enable_x64 (which this
+        # framework never sets) device_put silently canonicalizes int64
+        # to int32, wrapping the value in flight.
         from jax.experimental import multihost_utils
 
+        aux = int(aux)
+        if not 0 <= aux < 2**62:
+            raise ValueError(f"control aux {aux} out of range")
+        hi, lo = divmod(aux, 2**31)
         multihost_utils.broadcast_one_to_all(
-            (np.float32(flag), np.float32(aux))
+            (np.int32(flag), np.int32(hi), np.int32(lo))
         )
 
-    def _recv_control(self) -> tuple[float, float]:
+    def _recv_control(self) -> tuple[int, int]:
         from jax.experimental import multihost_utils
 
-        flag, aux = multihost_utils.broadcast_one_to_all(
-            (np.float32(0), np.float32(0))
+        flag, hi, lo = multihost_utils.broadcast_one_to_all(
+            (np.int32(0), np.int32(0), np.int32(0))
         )
-        return float(flag), float(aux)
+        return int(flag), int(hi) * 2**31 + int(lo)
 
     def _broadcast_payload(self, batch: np.ndarray) -> np.ndarray:
         from jax.experimental import multihost_utils
@@ -290,19 +409,27 @@ class CrossHostForward:
         )
         return artifact_variables_for_sharding(artifact)
 
-    def _run_round(self, batch: np.ndarray) -> np.ndarray:
+    def _run_round(self, batch: np.ndarray, fast: bool = False) -> np.ndarray:
         import jax
 
         local = self._local_shard(batch)
         global_batch = jax.make_array_from_process_local_data(
             self._batch_sharding, local, batch.shape
         )
-        logits = self._jitted(self._variables, global_batch)
+        # The leader dispatches fast rounds through the AOT executable its
+        # mode probe already compiled (resolve_mode); followers (and any
+        # bucket compiled after a reload) jit-dispatch, compiling lazily.
+        exe = self._fast_aot.get(batch.shape[0]) if fast else None
+        if exe is not None:
+            logits = exe(self._variables, global_batch)
+        else:
+            fn = self._fast_jitted() if fast else self._jitted_exact
+            logits = fn(self._variables, global_batch)
         from jax.experimental import multihost_utils
 
         return np.asarray(multihost_utils.process_allgather(logits, tiled=True))
 
-    def _watchdog(self, what: str):
+    def _watchdog(self, what: str, timeout_s: float):
         """Context manager: exit(70) if a lockstep round wedges (dead
         follower).  A blocked collective cannot be interrupted from Python,
         so process exit -- and the pod restart it triggers -- is the only
@@ -332,7 +459,7 @@ class CrossHostForward:
                     self._timer.cancel()
                 return False
 
-        return _Arm(self.round_timeout_s, what)
+        return _Arm(timeout_s, what)
 
 
 class CrossHostEngine:
@@ -376,9 +503,16 @@ class CrossHostEngine:
         # takes the same lock, so a version swap cannot split a round.
         self._lock = threading.Lock()
         self._m_images = None
+        self._m_fast_degraded = None
         if registry is not None:
             self._m_images = registry.counter(
                 "kdlt_engine_images_total", "images predicted (cross-host engine)"
+            )
+            # Same gauge name/semantics as runtime.InferenceEngine: operators
+            # alert on a fleet silently serving the slower exact graph.
+            self._m_fast_degraded = registry.gauge(
+                "kdlt_engine_fast_degraded",
+                "1 when a fused fast-path compile failure forced the exact graph",
             )
         # The engine computes from xh's device-sharded weights; drop the
         # artifact's redundant host-RAM copy of the variable tree (the
@@ -389,6 +523,10 @@ class CrossHostEngine:
     def ready(self) -> bool:
         return self._ready
 
+    @property
+    def fast_degraded(self) -> bool:
+        return self._xh.fast_degraded
+
     def warmup(self) -> float:
         import time
 
@@ -396,6 +534,8 @@ class CrossHostEngine:
         with self._lock:
             for b in self.buckets:
                 self._xh.predict(np.zeros((b, *self.spec.input_shape), np.uint8))
+        if self._m_fast_degraded is not None:
+            self._m_fast_degraded.set(1.0 if self._xh.fast_degraded else 0.0)
         self._ready = True
         return time.perf_counter() - t0
 
