@@ -134,6 +134,36 @@ def tensor_proto_from_array(
     return tp
 
 
+class ServableNotFound(Exception):
+    """Maps to NOT_FOUND, message already in TF-Serving's wording."""
+
+
+def _check_version_pin(request, model) -> None:
+    """Reject a request pinned to anything but the loaded version.
+
+    Covers BOTH arms of model_spec's version_choice oneof: a numeric
+    ``version`` other than the loaded one, and ANY ``version_label`` --
+    this server assigns no labels, so every label is unknown (real
+    TF-Serving fails an unknown label too; silently serving the live
+    version would be the exact mis-attribution ADVICE r3 flagged).
+    """
+    ms = request.model_spec
+    name = ms.name
+    try:
+        if ms.HasField("version_label") and ms.version_label:
+            raise ServableNotFound(
+                f"Servable not found for request: Specific({name}, "
+                f"label {ms.version_label!r}): no version labels are defined"
+            )
+        if ms.HasField("version") and int(ms.version.value) != model.version:
+            raise ServableNotFound(
+                f"Servable not found for request: "
+                f"Specific({name}, {int(ms.version.value)})"
+            )
+    except ValueError:  # older generated stubs without the oneof
+        return
+
+
 class PredictionServicer:
     """Implements PredictionService/Predict over a ModelServer's models."""
 
@@ -175,6 +205,10 @@ class PredictionServicer:
                 grpc.StatusCode.NOT_FOUND,
                 f"Servable not found for request: Latest({e.args[0]})",
             )
+        except ServableNotFound as e:
+            self._m_errors.inc()
+            status = "NOT_FOUND"
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         except ValueError as e:
             self._m_errors.inc()
             status = "INVALID_ARGUMENT"
@@ -223,6 +257,13 @@ class PredictionServicer:
                 grpc.StatusCode.NOT_FOUND,
                 f"Servable not found for request: Latest({name})",
             )
+        # A client pinning a version (or label) must not get metadata
+        # silently attributed to a different one (ADVICE r3): only the
+        # loaded version is resolvable here (one live version per model).
+        try:
+            _check_version_pin(request, model)
+        except ServableNotFound as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         fields = list(request.metadata_field) or ["signature_def"]
         if fields != ["signature_def"]:
             context.abort(
@@ -266,6 +307,10 @@ class PredictionServicer:
         model = self._server.models.get(name)
         if model is None:
             raise KeyError(name)
+        # Same version pinning contract as GetModelMetadata: a request for
+        # a version (or label) other than the loaded one is NOT_FOUND, not
+        # silently served from whatever is live.
+        _check_version_pin(request, model)
         spec = model.artifact.spec
         sig = request.model_spec.signature_name
         if sig not in ("", "serving_default"):
@@ -370,13 +415,17 @@ def serve_grpc(
     the servicer's MAX_IMAGES_PER_REQUEST/shape checks only run after full
     deserialization plus potential float32 casts, so an unbounded receive
     limit lets one hostile ~2 GiB message force several GiB of transient
-    allocation.  Default: a full MAX_IMAGES_PER_REQUEST batch as UINT8
-    (+50% proto/framing headroom) over the models loaded at startup --
-    ~0.8 GiB for the 299x299 flagship, a bound that actually BINDS below
-    gRPC's 2 GiB ceiling (an f32 budget would not).  Consequence, stated:
-    float32-encoded requests are transport-capped at ~MAX/4 images; ship
-    big batches as uint8 (the gateway does).  A model hot-loaded later
-    with a LARGER input shape needs a restart or an explicit
+    allocation.  Default: a full MAX_IMAGES_PER_REQUEST batch in the
+    LARGEST wire dtype the servicer accepts -- float32, the encoding the
+    reference gateway ships (reference model_server.py:35-36) -- plus 50%
+    proto/framing headroom, over the models loaded at startup, clamped to
+    gRPC's 2 GiB ceiling.  (Round 3 sized this for uint8, which
+    transport-rejected reference-style float32 batches the servicer's own
+    MAX_IMAGES_PER_REQUEST contract accepts -- ADVICE r3.)  For the
+    299x299 flagship the f32 budget clamps to the protocol ceiling, which
+    still bounds per-message transient allocation to ~2 GiB + one cast;
+    smaller models keep a binding sub-ceiling cap.  A model hot-loaded
+    later with a LARGER input shape needs a restart or an explicit
     ``max_receive_bytes`` -- the documented trade for a pre-parse guard.
     """
     limit = 2**31 - 1  # gRPC messages are int32-length-prefixed
@@ -386,7 +435,9 @@ def serve_grpc(
         )
 
         budgets = [
-            MAX_IMAGES_PER_REQUEST * int(np.prod(m.artifact.spec.input_shape))
+            MAX_IMAGES_PER_REQUEST
+            * int(np.prod(m.artifact.spec.input_shape))
+            * np.dtype(np.float32).itemsize
             for m in getattr(model_server, "models", {}).values()
         ]
         max_receive_bytes = (

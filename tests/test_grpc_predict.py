@@ -273,3 +273,44 @@ def test_get_model_metadata_signature(grpc_stack):
     with pytest.raises(grpc.RpcError) as ei:
         call(req2, timeout=30)
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+    # Pinning the loaded version resolves; pinning any other is NOT_FOUND
+    # with TF-Serving's Specific() wording (ADVICE r3: metadata must never
+    # be silently attributed to a different version than requested).
+    req3 = get_model_metadata_pb2.GetModelMetadataRequest()
+    req3.model_spec.name = spec.name
+    req3.model_spec.version.value = 1
+    assert call(req3, timeout=30).model_spec.version.value == 1
+    req3.model_spec.version.value = 7
+    with pytest.raises(grpc.RpcError) as ei:
+        call(req3, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    assert f"Specific({spec.name}, 7)" in ei.value.details()
+
+
+def test_predict_version_pinning(grpc_stack):
+    """Predict with model_spec.version: the loaded version serves; any
+    other version is NOT_FOUND (same contract as GetModelMetadata)."""
+    spec, _, predict, _ = grpc_stack
+    rng = np.random.default_rng(5)
+    X = rng.uniform(-1, 1, size=(1, *spec.input_shape)).astype(np.float32)
+
+    req = _reference_style_request(spec, X)
+    req.model_spec.version.value = 1
+    assert predict(req, timeout=20.0).model_spec.version.value == 1
+
+    req = _reference_style_request(spec, X)
+    req.model_spec.version.value = 99
+    with pytest.raises(grpc.RpcError) as ei:
+        predict(req, timeout=20.0)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    assert f"Specific({spec.name}, 99)" in ei.value.details()
+
+    # The OTHER oneof arm: this server defines no version labels, so any
+    # label pin is NOT_FOUND -- never silently served the live version.
+    req = _reference_style_request(spec, X)
+    req.model_spec.version_label = "stable"
+    with pytest.raises(grpc.RpcError) as ei:
+        predict(req, timeout=20.0)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    assert "stable" in ei.value.details()
