@@ -70,8 +70,15 @@ def main() -> None:
 
     for batch in (int(b) for b in args.batches.split(",")):
         row = [f"batch {batch:4d}:"]
-        for name, ek in (("xla-entry", False), ("kernel-entry", True)):
-            fwd = build_fast_forward(spec, dtype=jnp.bfloat16, entry_kernel=ek)
+        variants = (
+            ("xla-entry", dict(entry_kernel=False)),
+            ("kernel-entry", dict(entry_kernel=True)),
+            # VERDICT r3 #5: conv1 computed directly in (H, W, B, C) so the
+            # kernel's slab gather reads resident-layout data.
+            ("kernel-entry+conv1t", dict(entry_kernel=True, conv1_t=True)),
+        )
+        for name, kw in variants:
+            fwd = build_fast_forward(spec, dtype=jnp.bfloat16, **kw)
             ms = timed(fwd, batch) * 1e3
             row.append(f"{name} {ms:8.3f} ms ({batch / ms * 1e3:7.1f} img/s)")
         print("  ".join(row), flush=True)

@@ -208,15 +208,19 @@ def fused_sepconv_block_t(xt, dw, pw, scale, shift, *, bt: int = 0, interpret: b
 
 
 @functools.cache
-def _compiler_params() -> Any:
+def _compiler_params(limit_bytes: int = 96 * 1024 * 1024) -> Any:
     from jax.experimental.pallas import tpu as pltpu
 
     # The default 16 MiB scoped-vmem cap rejects the bt=16 tile; v5e has
-    # 128 MiB physical VMEM.  110 MiB admits the block3 chain (74x74,
-    # 128->256 channels) at bt=8, which peaks at ~107 MiB.
+    # 128 MiB physical VMEM.  Default 96 MiB: the serving path's largest
+    # tile needs far less, the measured speed at 96 vs 110 MiB is
+    # identical (exp/worker_fault_probe.py scan-long-96m), and round 3-4's
+    # recurring TPU worker faults make VMEM headroom cheap insurance.
+    # Only the experimental entry path's block3 chain (74x74, 128->256
+    # channels, peaks ~107 MiB at bt=8) requests 110 explicitly.
     # (CompilerParams was TPUCompilerParams in older jax releases.)
     params_cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-    return params_cls(vmem_limit_bytes=110 * 1024 * 1024)
+    return params_cls(vmem_limit_bytes=limit_bytes)
 
 
 def fused_sepconv_block(x, dw, pw, scale, shift, *, bt: int = 0, interpret: bool = False):
@@ -232,6 +236,7 @@ def fused_sepconv_chain_t(
     *,
     bt: int = 0,
     interpret: bool = False,
+    vmem_limit_bytes: int = 0,
 ):
     """A chain of sepconv+BN stages in one kernel, (H, W, B, C) layout.
 
@@ -305,7 +310,10 @@ def fused_sepconv_chain_t(
         in_specs=in_specs,
         out_specs=pl.BlockSpec((H, W, bt, c_out_final), lambda g: (0, 0, g, 0)),
         out_shape=jax.ShapeDtypeStruct((H, W, B, c_out_final), xt.dtype),
-        compiler_params=_compiler_params(),
+        compiler_params=(
+            _compiler_params(vmem_limit_bytes) if vmem_limit_bytes
+            else _compiler_params()
+        ),
         interpret=interpret,
     )(*args)
     return out if B_orig == B else out[:, :, :B_orig, :]

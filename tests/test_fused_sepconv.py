@@ -169,6 +169,16 @@ def test_fast_forward_entry_kernel_matches_flax(fast_spec):
     rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
     assert rel < 1e-2, f"entry-kernel fast path diverges from flax: {rel:.2e}"
 
+    # conv1_t variant (VERDICT r3 #5): conv1 computed in (H, W, B, C) via
+    # HWNC dimension_numbers must be numerically identical layout-math.
+    fast_t = build_fast_forward(
+        fast_spec, dtype=jnp.bfloat16, interpret=True, entry_kernel=True,
+        conv1_t=True,
+    )
+    got_t = np.asarray(jax.jit(fast_t)(variables, x), np.float32)
+    rel = np.abs(got_t - want).max() / (np.abs(want).max() + 1e-6)
+    assert rel < 1e-2, f"conv1_t fast path diverges from flax: {rel:.2e}"
+
 
 @pytest.fixture(scope="module")
 def fast_spec():
