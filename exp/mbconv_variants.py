@@ -51,7 +51,21 @@ def main():
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--scan-check", action="store_true",
                    help="also run a data-dependent chained-scan cross-check")
+    p.add_argument("--tile-budget-mb", type=int, default=0,
+                   help="override ops.fused_mbconv._TILE_BUDGET (MiB): raises "
+                        "the fusibility bar so the 75x75 stage-2 blocks fuse "
+                        "(bigger bt everywhere too); compile OOM = evidence")
     args = p.parse_args()
+
+    if args.tile_budget_mb:
+        import functools
+
+        from kubernetes_deep_learning_tpu.ops import fused_mbconv
+
+        fused_mbconv._TILE_BUDGET = args.tile_budget_mb << 20
+        fused_mbconv._compiler_params = functools.partial(
+            fused_mbconv._compiler_params.__wrapped__, 110 * 1024 * 1024
+        )
 
     import jax
     import jax.numpy as jnp

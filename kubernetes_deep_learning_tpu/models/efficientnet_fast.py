@@ -42,12 +42,8 @@ from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.ops.fused_mbconv import (
     fused_mbconv_block_t,
     mbconv_block_weights,
+    mbconv_fusible,
 )
-
-# A fused block keeps its bf16 expanded tile resident; cap it so the whole
-# working set (input + expanded + padded copy + f32 acc) stays well under
-# the kernel's 96 MiB vmem limit at bt=8.
-_TILE_BUDGET_BYTES = 24 << 20
 
 
 def block_plan(width: float, depth: float):
@@ -130,7 +126,7 @@ def build_fast_forward(
         return (
             stride == 1
             and expand != 1
-            and h * w * 8 * c_in * expand * 2 <= _TILE_BUDGET_BYTES
+            and mbconv_fusible(h, w, c_in * expand)
         )
 
     def forward(variables, x):

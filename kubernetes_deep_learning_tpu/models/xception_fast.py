@@ -75,6 +75,12 @@ def build_fast_forward(
     re-transposing internally is exactly what exp/model_fused_entry.py
     measures.
     """
+    if conv1_t and not entry_kernel:
+        raise ValueError(
+            "conv1_t requires entry_kernel=True (without the entry kernel "
+            "there is no transposed consumer; silently measuring the plain "
+            "XLA path would misattribute results)"
+        )
 
     def conv(x, kernel, stride=1, padding="SAME"):
         # flax nn.Conv(dtype=...) semantics: operands promoted to dtype,

@@ -128,15 +128,43 @@ def mbconv_block_reference(x, w):
     return x + z.astype(x.dtype)
 
 
+# The expanded activation's VMEM working set is ~8 bytes/element: the bf16
+# tile (2) + its zero-padded copy (2) + the f32 depthwise accumulator (4),
+# before register-allocator spill headroom -- the batch-64 B3 compile with
+# a bf16-only (2 B/elem) budget OOM'd VMEM at 159.5/128 MiB, 114 MiB of it
+# spill slots (recorded in exp/mbconv_variants.py's first run).
+_WORKING_SET_BYTES_PER_ELEM = 8
+_TILE_BUDGET = 32 << 20
+
+
+def mbconv_fusible(h: int, w: int, c_mid: int) -> bool:
+    """Whether the fused kernel's SMALLEST legal tile (bt=8) fits the VMEM
+    budget at this spatial extent; callers keep bigger blocks on XLA."""
+    return h * w * 8 * c_mid * _WORKING_SET_BYTES_PER_ELEM <= _TILE_BUDGET
+
+
+def pick_mbconv_bt(h: int, w: int, batch: int, c_mid: int) -> int:
+    """Largest 8-multiple batch tile whose working set fits the budget."""
+    for cand in (32, 24, 16, 8):
+        if (
+            batch % cand == 0
+            and h * w * cand * c_mid * _WORKING_SET_BYTES_PER_ELEM <= _TILE_BUDGET
+        ):
+            return cand
+    return 8
+
+
 @functools.cache
-def _compiler_params():
+def _compiler_params(limit_bytes: int = 96 * 1024 * 1024):
     from jax.experimental.pallas import tpu as pltpu
 
     params_cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-    # Smaller cap than fused_sepconv's 110 MiB: the largest fused B3 tile
-    # (38x38x8x192 expanded + f32 acc) peaks well under 64 MiB, and round
-    # 3's recurring TPU worker fault makes headroom cheap insurance.
-    return params_cls(vmem_limit_bytes=96 * 1024 * 1024)
+    # Same 96 MiB default as fused_sepconv (since round 4): the largest
+    # fused B3 tile under the default budget peaks well under 64 MiB, and
+    # the recurring TPU worker fault makes VMEM headroom cheap insurance.
+    # Parameterized so experiments can raise it without re-implementing
+    # the CompilerParams compat shim.
+    return params_cls(vmem_limit_bytes=limit_bytes)
 
 
 def fused_mbconv_block_t(xt, w, *, bt: int = 0, residual: bool = True,
@@ -164,14 +192,7 @@ def fused_mbconv_block_t(xt, w, *, bt: int = 0, residual: bool = True,
     k = w["dw"].shape[0]
     pad = k // 2
     if bt == 0:
-        # Largest 8-multiple whose expanded bf16 tile + f32 acc fits ~1/3
-        # of the 96 MiB cap (input + expanded + padded + acc live at once).
-        budget = 32 << 20
-        bt = 8
-        for cand in (32, 24, 16, 8):
-            if B % cand == 0 and H * W * cand * C_mid * 2 <= budget:
-                bt = cand
-                break
+        bt = pick_mbconv_bt(H, W, B, C_mid)
     bt = _legal_bt(bt, B)
 
     def kernel(x_ref, ew_ref, es_ref, eb_ref, dw_ref, ds_ref, db_ref,
