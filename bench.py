@@ -236,17 +236,18 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
             rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8), dev
         )
         # Auto-size the CHAINED-SCAN iteration count so the dev tunnel's
-        # ~70 ms dispatch RTT amortizes to a ~1-2% effect on that method:
+        # ~70 ms dispatch RTT amortizes to a <2% effect on that method:
         # at the old fixed 30 iterations it added ~2.3 ms/iteration
         # (round-3 finding: the device stream was packed -- trace span
         # 13.8 ms/iter at batch 64 -- while the bench reported 16.6).  A
         # short pipelined probe estimates the warm per-iteration time,
-        # then k targets ~7 s per timed scan call.  The PIPELINED method
-        # is separately burst-capped below and keeps a larger residual at
-        # tiny batches.  Production PCIe dispatch is tens of us, so the
-        # RTT is a harness artifact, not serving cost; the two-method
-        # agreement check still applies.
-        jax.block_until_ready(fwd_jit(variables, x))  # compile/warm this shape
+        # then k targets ~4 s per timed scan call (see the sizing note
+        # below -- longer single executions approach the TPU worker's
+        # kill boundary).  The PIPELINED method is separately burst-capped
+        # below and keeps a larger residual at tiny batches.  Production
+        # PCIe dispatch is tens of us, so the RTT is a harness artifact,
+        # not serving cost; the method agreement check still applies.
+        np.asarray(fwd_jit(variables, x))  # compile/warm this shape (real sync)
         if scan_len:
             k = scan_len
         else:
@@ -254,8 +255,21 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
             t0 = time.perf_counter()
             probe = [fwd_jit(variables, x) for _ in range(probe_n)]
             jax.block_until_ready(probe)
+            # REAL materialization: block_until_ready is a no-op on the
+            # axon tunnel until the data plane initializes, and a garbage
+            # (dispatch-rate) estimate here silently maxed k out at 8000 in
+            # rounds 3-4 -- producing 25-120 s single device executions,
+            # which is exactly what the "TPU worker crashed (kernel
+            # fault)" investigation (BENCH.md) finally pinned the crashes
+            # on: executions past roughly half a minute get the worker
+            # killed, while the same total work in shorter executions runs
+            # clean.
+            np.asarray(probe[-1])
             est = (time.perf_counter() - t0) / probe_n
-            k = int(max(24, min(8000, 7.0 / est)))
+            # Target ~4 s per timed scan execution: the tunnel's ~70 ms
+            # dispatch RTT amortizes to <2%, with >5x margin to the
+            # observed worker execution-duration limit.
+            k = int(max(24, min(2000, 4.0 / est)))
         if flops_img is None:
             # Cost analysis on the flax graph (see compiled_flops_per_image);
             # the TIMED forward may be the fused fast path.

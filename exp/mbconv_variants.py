@@ -33,12 +33,17 @@ def log(msg):
 def time_pipelined(fn, variables, x, k, reps):
     import jax
 
-    jax.block_until_ready(fn(variables, x))
+    # Real materialization, not just block_until_ready: on the axon tunnel
+    # b_u_r is a no-op until the data plane initializes (bench.py's
+    # worker-crash root cause), which would turn these timings into host
+    # dispatch rates.
+    np.asarray(fn(variables, x))
     per = []
     for _ in range(reps):
         t0 = time.perf_counter()
         outs = [fn(variables, x) for _ in range(k)]
         jax.block_until_ready(outs)
+        np.asarray(outs[-1])
         per.append((time.perf_counter() - t0) / k)
     return float(np.median(per))
 
@@ -58,14 +63,10 @@ def main():
     args = p.parse_args()
 
     if args.tile_budget_mb:
-        import functools
-
         from kubernetes_deep_learning_tpu.ops import fused_mbconv
 
         fused_mbconv._TILE_BUDGET = args.tile_budget_mb << 20
-        fused_mbconv._compiler_params = functools.partial(
-            fused_mbconv._compiler_params.__wrapped__, 110 * 1024 * 1024
-        )
+        fused_mbconv.VMEM_LIMIT_BYTES = 110 * 1024 * 1024
 
     import jax
     import jax.numpy as jnp
@@ -131,7 +132,9 @@ def main():
                 return acc
 
             for use_fast, tag in ((False, "flax"), (True, "fused")):
-                kk = max(24, int(2.0 / (t_fast if use_fast else t_flax)))
+                # Capped like bench.py's auto-k: single executions past
+                # ~30 s get the TPU worker killed (BENCH.md investigation).
+                kk = max(24, min(500, int(2.0 / (t_fast if use_fast else t_flax))))
                 float(chained(variables, x, kk, use_fast))  # compile+run
                 t0 = time.perf_counter()
                 float(chained(variables, x, kk, use_fast))

@@ -135,6 +135,10 @@ def mbconv_block_reference(x, w):
 # spill slots (recorded in exp/mbconv_variants.py's first run).
 _WORKING_SET_BYTES_PER_ELEM = 8
 _TILE_BUDGET = 32 << 20
+# Scoped-VMEM cap handed to the Mosaic compiler; module-level so
+# experiments can raise it alongside _TILE_BUDGET without monkeypatching
+# private internals (exp/mbconv_variants.py --tile-budget-mb).
+VMEM_LIMIT_BYTES = 96 * 1024 * 1024
 
 
 def mbconv_fusible(h: int, w: int, c_mid: int) -> bool:
@@ -155,15 +159,14 @@ def pick_mbconv_bt(h: int, w: int, batch: int, c_mid: int) -> int:
 
 
 @functools.cache
-def _compiler_params(limit_bytes: int = 96 * 1024 * 1024):
+def _compiler_params(limit_bytes: int):
     from jax.experimental.pallas import tpu as pltpu
 
     params_cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
-    # Same 96 MiB default as fused_sepconv (since round 4): the largest
-    # fused B3 tile under the default budget peaks well under 64 MiB, and
-    # the recurring TPU worker fault makes VMEM headroom cheap insurance.
-    # Parameterized so experiments can raise it without re-implementing
-    # the CompilerParams compat shim.
+    # Same 96 MiB default as fused_sepconv (since round 4, via
+    # VMEM_LIMIT_BYTES): the largest fused B3 tile under the default
+    # budget peaks well under 64 MiB, and the recurring TPU worker fault
+    # made VMEM headroom cheap insurance.
     return params_cls(vmem_limit_bytes=limit_bytes)
 
 
@@ -259,7 +262,7 @@ def fused_mbconv_block_t(xt, w, *, bt: int = 0, residual: bool = True,
         ],
         out_specs=pl.BlockSpec((H, W, bt, C_out), lambda g: (0, 0, g, 0)),
         out_shape=jax.ShapeDtypeStruct((H, W, B, C_out), xt.dtype),
-        compiler_params=_compiler_params(),
+        compiler_params=_compiler_params(VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(
         xt, w["expand_w"], w["expand_s"], w["expand_b"],
