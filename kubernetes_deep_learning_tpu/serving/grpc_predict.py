@@ -453,6 +453,13 @@ def serve_grpc(
         ],
     )
     add_to_server(PredictionServicer(model_server), server)
+    # TF-Serving's management surface rides the same port, as in the binary.
+    from kubernetes_deep_learning_tpu.serving.grpc_model_service import (
+        ModelServicer,
+        add_model_service_to_server,
+    )
+
+    add_model_service_to_server(ModelServicer(model_server), server)
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise OSError(f"could not bind gRPC port {port}")

@@ -190,6 +190,7 @@ class ModelServer:
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self._profile_lock = threading.Lock()
+        self._poll_lock = threading.Lock()  # serializes version scans
         self.poll_versions()
         if not self.models:
             raise FileNotFoundError(f"no model artifacts under {model_root!r}")
@@ -222,11 +223,20 @@ class ModelServer:
         before the swap**, so serving never routes to a cold engine; the
         swap rebinds ``self.models`` to a fresh dict (copy-on-write), so
         handler threads iterating the old snapshot never see a mutation.
+        Scans themselves are serialized on a lock: with the watcher thread
+        AND the gRPC ModelService reload RPC both calling in (round 4),
+        two concurrent scans would each snapshot ``self.models``, double-
+        load/warm the same version, and the loser's stale-snapshot swap
+        could resurrect an already-closed engine.
         Layout invariant: the artifact's spec.name must equal its directory
         name -- it is the serving key, URL path, and version-comparison key
         at once; mismatched artifacts are skipped loudly.  Returns "name vN"
         per swap.
         """
+        with self._poll_lock:
+            return self._poll_versions_locked()
+
+    def _poll_versions_locked(self) -> list[str]:
         import os
 
         updated: list[str] = []

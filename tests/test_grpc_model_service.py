@@ -1,0 +1,160 @@
+"""TF-Serving ModelService surface (GetModelStatus + HandleReloadConfig)
+over the real gRPC server -- the management RPCs the reference's tier
+carries in the TF-Serving binary (reference tf-serving.dockerfile:2)."""
+
+from __future__ import annotations
+
+import grpc
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.export.exporter import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.serving.grpc_model_service import (
+    MODEL_SERVICE_NAME,
+)
+from kubernetes_deep_learning_tpu.serving.grpc_predict import serve_grpc
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+    get_model_status_pb2,
+    model_management_pb2,
+)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    spec = register_spec(
+        ModelSpec(
+            name="msvc-vit",
+            family="vit-tiny",
+            input_shape=(16, 16, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+        )
+    )
+    root = tmp_path_factory.mktemp("msvc-models")
+    export_model(spec, init_variables(spec, seed=0), str(root))
+    server = ModelServer(str(root), port=0, buckets=(1, 2), max_delay_ms=1.0)
+    server.warmup()
+    grpc_server, port = serve_grpc(server, 0, host="127.0.0.1")
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    status_call = channel.unary_unary(
+        f"/{MODEL_SERVICE_NAME}/GetModelStatus",
+        request_serializer=get_model_status_pb2.GetModelStatusRequest.SerializeToString,
+        response_deserializer=get_model_status_pb2.GetModelStatusResponse.FromString,
+    )
+    reload_call = channel.unary_unary(
+        f"/{MODEL_SERVICE_NAME}/HandleReloadConfigRequest",
+        request_serializer=model_management_pb2.ReloadConfigRequest.SerializeToString,
+        response_deserializer=model_management_pb2.ReloadConfigResponse.FromString,
+    )
+    yield spec, str(root), server, status_call, reload_call, port
+    channel.close()
+    grpc_server.stop(grace=None)
+    server.shutdown()
+
+
+def test_get_model_status_available(stack):
+    spec, _root, _server, status_call, _, _ = stack
+    req = get_model_status_pb2.GetModelStatusRequest()
+    req.model_spec.name = spec.name
+    resp = status_call(req, timeout=30)
+    (st,) = resp.model_version_status
+    assert st.version == 1
+    assert st.state == get_model_status_pb2.ModelVersionStatus.AVAILABLE
+    assert st.status.error_code == 0
+
+    # Version pinning mirrors Predict/GetModelMetadata's contract.
+    req.model_spec.version.value = 1
+    assert status_call(req, timeout=30).model_version_status[0].version == 1
+    req.model_spec.version.value = 9
+    with pytest.raises(grpc.RpcError) as ei:
+        status_call(req, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+    req2 = get_model_status_pb2.GetModelStatusRequest()
+    req2.model_spec.name = "nope"
+    with pytest.raises(grpc.RpcError) as ei:
+        status_call(req2, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_reload_config_picks_up_new_version(stack):
+    spec, root, server, status_call, reload_call, _ = stack
+    # Drop a v2 artifact, then apply a config naming the model: the reload
+    # must synchronously hot-load v2 (the version watcher's scan).
+    export_model(spec, init_variables(spec, seed=5), root)
+    assert art.latest_version(root, spec.name) == 2
+
+    req = model_management_pb2.ReloadConfigRequest()
+    mc = req.config.model_config_list.config.add()
+    mc.name = spec.name
+    resp = reload_call(req, timeout=60)
+    assert resp.status.error_code == 0, resp.status.error_message
+    assert server.models[spec.name].version == 2
+
+    sreq = get_model_status_pb2.GetModelStatusRequest()
+    sreq.model_spec.name = spec.name
+    assert status_call(sreq, timeout=30).model_version_status[0].version == 2
+
+
+def test_reload_config_rejections(stack):
+    spec, _root, _server, _status, reload_call, _ = stack
+    # Empty list = TF-Serving's unload-everything: refused loudly.
+    with pytest.raises(grpc.RpcError) as ei:
+        reload_call(model_management_pb2.ReloadConfigRequest(), timeout=30)
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    req = model_management_pb2.ReloadConfigRequest()
+    req.config.model_config_list.SetInParent()
+    with pytest.raises(grpc.RpcError) as ei:
+        reload_call(req, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+    # base_path outside the server's root: refused, not half-honored.
+    req = model_management_pb2.ReloadConfigRequest()
+    mc = req.config.model_config_list.config.add()
+    mc.name = spec.name
+    mc.base_path = "/somewhere/else"
+    with pytest.raises(grpc.RpcError) as ei:
+        reload_call(req, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+    # Unknown model name: reload applies, but the response status says
+    # NOT_FOUND (TF-Serving's StatusProto convention, not a transport error).
+    req = model_management_pb2.ReloadConfigRequest()
+    req.config.model_config_list.config.add().name = "ghost"
+    resp = reload_call(req, timeout=60)
+    assert resp.status.error_code == 5
+    assert "ghost" in resp.status.error_message
+
+
+def test_reload_config_rejects_unknown_model_config_fields(stack):
+    """A stock client setting a ModelConfig field outside the hand-written
+    subset (e.g. model_version_policy, field 7) must be refused, not
+    silently ignored while the reload reports OK."""
+    spec, _root, _server, _status, _reload, grpc_port = stack
+    # Splice a field-7 submessage into the nested wire encoding by hand
+    # (tag 0x3A = field 7, wire type 2).
+    inner = model_management_pb2.ModelConfig(
+        name=spec.name
+    ).SerializeToString() + bytes([0x3A, 0x02, 0x08, 0x01])
+    lst = bytes([0x0A, len(inner)]) + inner     # ModelConfigList.config
+    cfg = bytes([0x0A, len(lst)]) + lst         # ModelServerConfig.model_config_list
+    reqb = bytes([0x0A, len(cfg)]) + cfg        # ReloadConfigRequest.config
+    parsed = model_management_pb2.ReloadConfigRequest.FromString(reqb)
+    assert parsed.config.model_config_list.config[0].name == spec.name
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+    raw_call = channel.unary_unary(
+        f"/{MODEL_SERVICE_NAME}/HandleReloadConfigRequest",
+        request_serializer=lambda b: b,
+        response_deserializer=model_management_pb2.ReloadConfigResponse.FromString,
+    )
+    with pytest.raises(grpc.RpcError) as ei:
+        raw_call(reqb, timeout=30)
+    assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert "unsupported" in ei.value.details()
+    channel.close()
