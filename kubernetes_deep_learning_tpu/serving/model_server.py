@@ -202,7 +202,7 @@ class ModelServer:
     def warmup(self) -> None:
         for m in self.models.values():
             dt = m.engine.warmup()
-            print(f"warmed {m.artifact.spec.name}: {dt:.1f}s")
+            print(f"warmed {m.artifact.spec.name}: {dt:.1f}s", file=sys.stderr)
 
     @property
     def ready(self) -> bool:
@@ -280,7 +280,7 @@ class ModelServer:
                 if fresh is not None:  # warmup failed post-construction
                     fresh.close()
                     self.registry.remove(fresh.registry_child)
-                print(f"version watcher: skipping {name} v{version}: {e}")
+                print(f"version watcher: skipping {name} v{version}: {e}", file=sys.stderr)
                 continue
             old = self.models.get(name)
             self.models = {**self.models, name: fresh}
@@ -288,7 +288,7 @@ class ModelServer:
                 old.close()
                 self.registry.remove(old.registry_child)
             updated.append(f"{name} v{version}")
-            print(f"loaded {name} v{version} from {directory}")
+            print(f"loaded {name} v{version} from {directory}", file=sys.stderr)
         return updated
 
     def start_version_watcher(self, interval_s: float = 10.0) -> None:
@@ -299,7 +299,7 @@ class ModelServer:
                 try:
                     self.poll_versions()
                 except Exception as e:
-                    print(f"version watcher error: {e}")
+                    print(f"version watcher error: {e}", file=sys.stderr)
 
         self._watcher = threading.Thread(
             target=loop, name="kdlt-version-watcher", daemon=True

@@ -1,0 +1,42 @@
+"""bench.py's fault-isolation contract (VERDICT r3 #1a), via the real CLI.
+
+A faulting batch point must be retried, recorded in the JSON's ``faults``
+list, and must NOT abort the sweep or crash the parent -- one fault
+nullified the whole official record in rounds 1-3.  The forced fault here
+is an unknown model name: the child dies before any device use (get_spec
+raises first), so the test never dials the single-client TPU tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+
+
+def test_faulted_points_are_recorded_not_fatal():
+    proc = subprocess.run(
+        [
+            sys.executable, _BENCH,
+            "--batches", "2,4",
+            "--model", "no-such-model",
+            "--point-timeout", "120",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        timeout=300,
+    )
+    # Every point faulted -> rc=1, but the parent still emits its one JSON
+    # line with the full fault record (nothing hidden, nothing crashed).
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+    assert "EVERY batch point faulted" in out["metric"]
+    # Both points, both attempts each: the sweep continued past the first
+    # fault and each fault carries the child's stderr tail.
+    attempts = [(f["batch"], f["attempt"]) for f in out["faults"]]
+    assert attempts == [(2, 1), (2, 2), (4, 1), (4, 2)]
+    assert all("no-such-model" in f["fault"] for f in out["faults"])
