@@ -943,8 +943,11 @@ def main() -> int:
     p.add_argument("--model", default="clothing-model",
                    help="ModelSpec name to bench (see modelspec.list_specs)")
     # 1..128 is BASELINE.json's sweep; 48/56 bracket the p50<=15ms latency
-    # bound on v5e; 256/1024 probe the unbound throughput ceiling.
-    p.add_argument("--batches", default="1,2,4,8,16,32,48,56,64,128,256,1024")
+    # bound on v5e; 256 probes the unbound throughput ceiling.  1024 was
+    # dropped from the default in round 4: it reads within noise of 256
+    # (4616 vs 4570 img/s) and cost ~15 min of the official run's budget
+    # -- pass --batches to sweep it explicitly.
+    p.add_argument("--batches", default="1,2,4,8,16,32,48,56,64,128,256")
     p.add_argument("--scan-len", type=int, default=0,
                    help="fwd passes per timed chained-scan call (0 = auto-size "
                         "per batch to amortize dispatch RTT); the pipelined "
@@ -1141,7 +1144,7 @@ def main() -> int:
     print(json.dumps(out), flush=True)
     # rc=0 iff the in-bound headline exists: a valid (physics-passing) batch
     # met the latency bound and survived.  Faults at other points (e.g. the
-    # out-of-bound 256/1024 ceiling probes) are reported but do not nullify
+    # out-of-bound 256 ceiling probe) are reported but do not nullify
     # an in-bound record.
     return 0 if (valid_pool and headline_batch in eligible) else 1
 
