@@ -1,0 +1,138 @@
+"""Does chunking big batches into 16-image microbatches beat the monolith?
+
+Motivation (exp/batch_dip_trace.py): the fused serving path's device time
+per image is non-monotonic in batch -- 197 us/img at batch 16 vs 222/232
+at 32/48 -- because XLA picks worse fusion schedules for the entry flow at
+those sizes.  If a single jitted program that runs batch 32 as
+``lax.map`` over 2 chunks of 16 lands near 2 x the batch-16 span, the
+engine should serve every bucket >16 as chunked-16 and the whole in-bound
+band lifts ~10-15%.
+
+Measures, per batch in --batches: monolithic device span vs chunked device
+span (profiler trace totals, RTT-immune), plus logits equivalence.
+
+Usage: python exp/chunked_forward.py --batches 32 48 64 128 [--chunk 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def device_span_ms(fn, args_, iters: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn(*args_))  # compile
+    trace_dir = tempfile.mkdtemp(prefix="kdlt-chunk-")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(iters):
+            jax.block_until_ready(fn(*args_))
+    files = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+    pids = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pids[ev["pid"]] = ev["args"].get("name", "")
+    device_pids = {
+        pid for pid, name in pids.items() if name.startswith("/device:TPU")
+    }
+    total = 0.0
+    for ev in trace["traceEvents"]:
+        if (
+            ev.get("ph") == "X"
+            and ev.get("pid") in device_pids
+            and not ev.get("name", "").startswith("jit_")
+        ):
+            total += ev.get("dur", 0) / 1e3
+    return total / iters
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batches", type=int, nargs="+", default=[32, 48, 64, 128])
+    p.add_argument("--chunk", type=int, default=16)
+    p.add_argument("--iters", type=int, default=6)
+    p.add_argument(
+        "--unrolled",
+        action="store_true",
+        help="python-loop unroll instead of lax.map (XLA schedules freely)",
+    )
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.models.xception_fast import (
+        build_fast_forward,
+    )
+    from kubernetes_deep_learning_tpu.modelspec import get_spec
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    spec = get_spec("clothing-model")
+    dev = jax.devices()[0]
+    variables = jax.device_put(init_variables(spec, seed=0), dev)
+    # chunk=False pins the MONOLITHIC program: since round 4 the serving
+    # fast path chunks 32-64 by default (the result of this experiment),
+    # so the baseline arm must opt out or both arms measure the same thing.
+    inner = build_fast_forward(spec, dtype=jnp.bfloat16, chunk=False)
+
+    def fwd(v, x):
+        return inner(v, normalize(x, spec.preprocessing)).astype(jnp.float32)
+
+    mono = jax.jit(fwd)
+
+    def chunked(v, x):
+        k = x.shape[0] // args.chunk
+        xs = x.reshape(k, args.chunk, *x.shape[1:])
+        return jax.lax.map(lambda c: fwd(v, c), xs).reshape(
+            x.shape[0], -1
+        )
+
+    def unrolled(v, x):
+        k = x.shape[0] // args.chunk
+        outs = [
+            fwd(v, x[i * args.chunk : (i + 1) * args.chunk]) for i in range(k)
+        ]
+        return jnp.concatenate(outs, axis=0)
+
+    chk = jax.jit(unrolled if args.unrolled else chunked)
+
+    rng = np.random.default_rng(0)
+    print(f"chunk={args.chunk}  (device-span ms/iter via profiler trace)")
+    print("batch   mono ms (us/img)   chunked ms (us/img)   chunk/mono")
+    for b in args.batches:
+        if b % args.chunk:
+            print(f"{b:5d}   skipped (not a multiple of {args.chunk})")
+            continue
+        x = jax.device_put(
+            rng.integers(0, 256, (b, *spec.input_shape), np.uint8), dev
+        )
+        lm = np.asarray(mono(variables, x))
+        lc = np.asarray(chk(variables, x))
+        rel = float(
+            np.max(np.abs(lm - lc) / (np.max(np.abs(lm)) + 1e-9))
+        )
+        m = device_span_ms(mono, (variables, x), args.iters)
+        c = device_span_ms(chk, (variables, x), args.iters)
+        print(
+            f"{b:5d}   {m:7.2f} ({m / b * 1e3:5.1f})      "
+            f"{c:7.2f} ({c / b * 1e3:5.1f})        {c / m:5.2f}x"
+            f"   max-rel {rel:.1e}"
+        )
+
+
+if __name__ == "__main__":
+    main()

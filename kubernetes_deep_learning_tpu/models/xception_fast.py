@@ -40,6 +40,25 @@ from kubernetes_deep_learning_tpu.ops.fused_sepconv import (
 _ENTRY_BLOCKS = ((2, 128), (3, 256), (4, 728))  # keep in sync with models.xception
 _MIDDLE_BLOCKS = tuple(range(5, 13))
 
+# Microbatch chunking (round 4).  The fused path's device time per image is
+# non-monotonic in batch: 197 us/img at batch 16 but 222/232/209 at
+# 32/48/64 (exp/batch_dip_trace.py) -- XLA picks worse entry-flow fusion
+# schedules at those sizes.  Running those batches as UNROLLED 16-image
+# chunks inside one jitted program restores the batch-16 schedule per
+# chunk: 0.88x/0.84x/0.92x device span at 32/48/64, while 128 is faster
+# monolithic (1.07x chunked) -- measured on a v5e chip
+# (exp/chunked_forward.py).  lax.map chunking is NOT equivalent: the loop
+# body compiles ~2x slower than the same chunk standalone (1.7-1.8x net).
+_CHUNK = 16
+_CHUNK_MIN, _CHUNK_MAX = 32, 64
+
+
+def _chunk_count(batch: int) -> int:
+    """How many 16-image chunks to split ``batch`` into (0 = monolithic)."""
+    if batch % _CHUNK == 0 and _CHUNK_MIN <= batch <= _CHUNK_MAX:
+        return batch // _CHUNK
+    return 0
+
 
 def build_fast_forward(
     spec: ModelSpec,
@@ -47,11 +66,21 @@ def build_fast_forward(
     interpret: bool = False,
     entry_kernel: bool = False,
     conv1_t: bool = False,
+    chunk: bool = True,
 ) -> Callable:
     """Return ``f(variables, normalized_f32_images) -> logits (dtype)``.
 
     The caller (models.build_forward) handles uint8 normalization and the
     final f32 cast, exactly as for the flax path.
+
+    ``chunk`` (default on) runs 16-multiple batches in [32, 64] (i.e.
+    32/48/64; 56 stays monolithic) as unrolled 16-image microbatches
+    inside the same program, which sidesteps XLA's worse
+    entry-flow schedules at those sizes (+9-19% device throughput,
+    exp/chunked_forward.py; see ``_chunk_count``).  Per-image numerics are
+    those of the batch-16 program -- same bf16-noise tolerance vs flax.
+    Off for the experimental entry-kernel paths so their measurements stay
+    monolithic and attributable.
 
     ``entry_kernel`` (EXPERIMENTAL, default off) routes conv2+block2
     through the fused entry Pallas kernel (ops.fused_entry) and blocks 3/4
@@ -154,7 +183,7 @@ def build_fast_forward(
         )
         return pooled + res
 
-    def forward(variables, x):
+    def forward_one(variables, x):
         p = variables["params"]
         s = variables["batch_stats"]
 
@@ -263,5 +292,15 @@ def build_fast_forward(
         return x @ jnp.asarray(logits["kernel"], dtype) + jnp.asarray(
             logits["bias"], dtype
         )
+
+    def forward(variables, x):
+        k = _chunk_count(x.shape[0]) if chunk and not entry_kernel else 0
+        if k:
+            outs = [
+                forward_one(variables, x[i * _CHUNK : (i + 1) * _CHUNK])
+                for i in range(k)
+            ]
+            return jnp.concatenate(outs, axis=0)
+        return forward_one(variables, x)
 
     return forward

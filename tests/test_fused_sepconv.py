@@ -226,6 +226,47 @@ def test_fast_forward_matches_flax(fast_spec):
     assert rel < 1e-2, f"fast path diverges from flax graph: {rel:.2e}"
 
 
+def test_chunk_count_rules():
+    """Microbatch chunking engages exactly for 16-multiples in [32, 64]
+    (measured win zone, exp/chunked_forward.py); everything else monolithic."""
+    from kubernetes_deep_learning_tpu.models.xception_fast import _chunk_count
+
+    assert _chunk_count(32) == 2
+    assert _chunk_count(48) == 3
+    assert _chunk_count(64) == 4
+    for n in (1, 8, 16, 24, 56, 96, 128, 256):
+        assert _chunk_count(n) == 0, n
+
+
+def test_chunked_fast_forward_matches_monolithic(fast_spec, monkeypatch):
+    """The chunk wrapper (slice -> forward_one -> concat) must be a pure
+    batching identity.  Scaled down (chunk=1 over batch 2) so interpret-mode
+    cost stays test-sized; the production chunk geometry (16 over 32-64) is
+    exercised on real TPU by bench.py's sweep."""
+    from kubernetes_deep_learning_tpu.models import xception_fast
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    monkeypatch.setattr(xception_fast, "_CHUNK", 1)
+    monkeypatch.setattr(xception_fast, "_CHUNK_MIN", 2)
+    monkeypatch.setattr(xception_fast, "_CHUNK_MAX", 2)
+
+    rng = np.random.default_rng(5)
+    variables = init_variables(fast_spec, seed=1)
+    images = rng.integers(0, 256, (2, *fast_spec.input_shape), np.uint8)
+    x = normalize(jnp.asarray(images), fast_spec.preprocessing)
+
+    mono = xception_fast.build_fast_forward(
+        fast_spec, dtype=jnp.bfloat16, interpret=True, chunk=False
+    )
+    chunked = xception_fast.build_fast_forward(
+        fast_spec, dtype=jnp.bfloat16, interpret=True, chunk=True
+    )
+    want = np.asarray(jax.jit(mono)(variables, x), np.float32)
+    got = np.asarray(jax.jit(chunked)(variables, x), np.float32)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_middle_block_weights_shapes(fast_spec):
     variables = init_variables(fast_spec, seed=0)
     dw, pw, s, b = middle_block_weights(
