@@ -27,7 +27,7 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def trace_batch(batch: int, iters: int) -> dict[str, float]:
+def trace_batch(batch: int, iters: int, model: str = "clothing-model"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -35,7 +35,7 @@ def trace_batch(batch: int, iters: int) -> dict[str, float]:
     from kubernetes_deep_learning_tpu.models import build_forward, init_variables
     from kubernetes_deep_learning_tpu.modelspec import get_spec
 
-    spec = get_spec("clothing-model")
+    spec = get_spec(model)
     dev = jax.devices()[0]
     variables = jax.device_put(init_variables(spec, seed=0), dev)
     fwd = jax.jit(build_forward(spec, dtype=jnp.bfloat16, fast="auto"))
@@ -65,6 +65,7 @@ def trace_batch(batch: int, iters: int) -> dict[str, float]:
         pid for pid, name in pids.items() if name.startswith("/device:TPU")
     }
     agg: dict[str, float] = defaultdict(float)
+    details: dict[str, str] = {}
     for ev in trace["traceEvents"]:
         if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
             continue
@@ -74,7 +75,11 @@ def trace_batch(batch: int, iters: int) -> dict[str, float]:
         # Collapse instance suffixes (fusion.123 -> fusion) lightly: keep
         # the numbered name (distinct ops) but strip duplicate-run suffixes.
         agg[name] += ev.get("dur", 0) / 1e3 / iters  # -> ms/iter
-    return dict(agg)
+        a = ev.get("args") or {}
+        d = a.get("long_name") or a.get("hlo_op") or a.get("tf_op") or ""
+        if d:  # don't pin "" from an argless first event
+            details.setdefault(name, d)
+    return dict(agg), details
 
 
 def main() -> None:
@@ -82,11 +87,13 @@ def main() -> None:
     p.add_argument("--batches", type=int, nargs="+", default=[16, 32, 48, 64])
     p.add_argument("--iters", type=int, default=6)
     p.add_argument("--top", type=int, default=16)
+    p.add_argument("--model", default="clothing-model")
     args = p.parse_args()
 
     per_batch: dict[int, dict[str, float]] = {}
+    per_batch_details: dict[int, dict[str, str]] = {}
     for b in args.batches:
-        per_batch[b] = trace_batch(b, args.iters)
+        per_batch[b], per_batch_details[b] = trace_batch(b, args.iters, args.model)
         total = sum(per_batch[b].values())
         print(
             f"batch {b:4d}: total {total:7.2f} ms/iter, "
@@ -98,12 +105,17 @@ def main() -> None:
     names = sorted(per_batch[big], key=lambda n: -per_batch[big][n])[: args.top]
     hdr = "op".ljust(34) + "".join(f"  b{b:<4d} (us/img)" for b in args.batches)
     print("\n" + hdr)
+    # Detail strings come from the ranked (largest) batch's own program:
+    # op names like fusion.123 are per-compile identities and must not be
+    # annotated from a different batch size's trace.
+    details = per_batch_details[big]
     for n in names:
         row = n[:33].ljust(34)
         for b in args.batches:
             ms = per_batch[b].get(n, 0.0)
             row += f"  {ms:6.2f} ({ms / b * 1000:5.1f})"
-        print(row)
+        d = details.get(n, "")
+        print(row + ("   " + d[:90] if d else ""))
 
     # Bucket into families for the summary.
     fam_of = lambda n: (  # noqa: E731

@@ -38,6 +38,7 @@ def device_span_ms(fn, args_, iters: int) -> float:
     files = glob.glob(
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
     )
+    assert files, f"no trace files under {trace_dir}"
     with gzip.open(files[0], "rt") as f:
         trace = json.load(f)
     pids = {}
@@ -68,29 +69,47 @@ def main() -> None:
         action="store_true",
         help="python-loop unroll instead of lax.map (XLA schedules freely)",
     )
+    p.add_argument(
+        "--model",
+        default="clothing-model",
+        help="ModelSpec name; non-Xception models measure the plain "
+        "build_forward program in both arms (no production chunking exists "
+        "for them -- this is the scoping measurement)",
+    )
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from kubernetes_deep_learning_tpu.models import init_variables
-    from kubernetes_deep_learning_tpu.models.xception_fast import (
-        build_fast_forward,
+    from kubernetes_deep_learning_tpu.models import (
+        build_forward,
+        has_fast_forward,
+        init_variables,
     )
     from kubernetes_deep_learning_tpu.modelspec import get_spec
     from kubernetes_deep_learning_tpu.ops.preprocess import normalize
 
-    spec = get_spec("clothing-model")
+    spec = get_spec(args.model)
     dev = jax.devices()[0]
     variables = jax.device_put(init_variables(spec, seed=0), dev)
-    # chunk=False pins the MONOLITHIC program: since round 4 the serving
-    # fast path chunks 32-64 by default (the result of this experiment),
-    # so the baseline arm must opt out or both arms measure the same thing.
-    inner = build_fast_forward(spec, dtype=jnp.bfloat16, chunk=False)
+    if has_fast_forward(spec):
+        from kubernetes_deep_learning_tpu.models.xception_fast import (
+            build_fast_forward,
+        )
 
-    def fwd(v, x):
-        return inner(v, normalize(x, spec.preprocessing)).astype(jnp.float32)
+        # chunk=False pins the MONOLITHIC program: since round 4 the serving
+        # fast path chunks 32-64 by default (the result of this experiment),
+        # so the baseline arm must opt out or both arms measure the same.
+        inner = build_fast_forward(spec, dtype=jnp.bfloat16, chunk=False)
+
+        def fwd(v, x):
+            return inner(v, normalize(x, spec.preprocessing)).astype(
+                jnp.float32
+            )
+
+    else:
+        fwd = build_forward(spec, dtype=jnp.bfloat16, fast="auto")
 
     mono = jax.jit(fwd)
 
@@ -102,9 +121,16 @@ def main() -> None:
         )
 
     def unrolled(v, x):
-        k = x.shape[0] // args.chunk
+        # 16-chunks plus an optional trailing 8-chunk, so 8-multiples that
+        # are not 16-multiples (40, 56) can chunk too: the batch-8 program
+        # is ALSO faster per image (255 us) than the 32-48 monoliths.
+        n, c = x.shape[0], args.chunk
+        bounds = list(range(0, n - n % c, c))
+        if n % c:
+            bounds.append(n - n % c)
         outs = [
-            fwd(v, x[i * args.chunk : (i + 1) * args.chunk]) for i in range(k)
+            fwd(v, x[lo : lo + min(c, n - lo)])
+            for lo in bounds
         ]
         return jnp.concatenate(outs, axis=0)
 
@@ -114,8 +140,9 @@ def main() -> None:
     print(f"chunk={args.chunk}  (device-span ms/iter via profiler trace)")
     print("batch   mono ms (us/img)   chunked ms (us/img)   chunk/mono")
     for b in args.batches:
-        if b % args.chunk:
-            print(f"{b:5d}   skipped (not a multiple of {args.chunk})")
+        need = 8 if args.unrolled else args.chunk
+        if b % need:
+            print(f"{b:5d}   skipped (not a multiple of {need})")
             continue
         x = jax.device_put(
             rng.integers(0, 256, (b, *spec.input_shape), np.uint8), dev

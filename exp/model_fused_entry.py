@@ -78,7 +78,11 @@ def main() -> None:
             ("kernel-entry+conv1t", dict(entry_kernel=True, conv1_t=True)),
         )
         for name, kw in variants:
-            fwd = build_fast_forward(spec, dtype=jnp.bfloat16, **kw)
+            # chunk=False: every arm must measure the MONOLITHIC program --
+            # since round 4 the serving default chunks batches 32-64, which
+            # would speed up only the xla-entry baseline (entry_kernel arms
+            # disable chunking) and under-credit the kernel arms.
+            fwd = build_fast_forward(spec, dtype=jnp.bfloat16, chunk=False, **kw)
             ms = timed(fwd, batch) * 1e3
             row.append(f"{name} {ms:8.3f} ms ({batch / ms * 1e3:7.1f} img/s)")
         print("  ".join(row), flush=True)

@@ -35,8 +35,11 @@ def main() -> None:
     spec = get_spec("clothing-model")
     dev = jax.devices()[0]
     variables = jax.device_put(init_variables(spec, seed=0), dev)
+    # chunk=False: this probe's op-sum vs bench-p50 comparison is against
+    # recorded MONOLITHIC traces; the round-4 serving default would swap in
+    # the chunked program at batches 32-64 and shift the op inventory.
     inner = build_fast_forward(
-        spec, dtype=jnp.bfloat16, entry_kernel=args.entry_kernel
+        spec, dtype=jnp.bfloat16, entry_kernel=args.entry_kernel, chunk=False
     )
     fwd = jax.jit(
         lambda v, img: inner(v, normalize(img, spec.preprocessing)).astype(

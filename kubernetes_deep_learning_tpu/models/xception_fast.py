@@ -47,17 +47,23 @@ _MIDDLE_BLOCKS = tuple(range(5, 13))
 # chunks inside one jitted program restores the batch-16 schedule per
 # chunk: 0.88x/0.84x/0.92x device span at 32/48/64, while 128 is faster
 # monolithic (1.07x chunked) -- measured on a v5e chip
-# (exp/chunked_forward.py).  lax.map chunking is NOT equivalent: the loop
-# body compiles ~2x slower than the same chunk standalone (1.7-1.8x net).
+# (exp/chunked_forward.py).  8-multiples that are not 16-multiples (40,
+# 56) take a trailing 8-image chunk (batch-8 also beats the 32-64
+# monoliths per image): 0.87x at 40.  lax.map chunking is NOT equivalent:
+# the loop body compiles ~2x slower than the same chunk standalone
+# (1.7-1.8x net).
 _CHUNK = 16
+_TAIL = 8  # trailing-chunk granularity (the kernels' sublane alignment)
 _CHUNK_MIN, _CHUNK_MAX = 32, 64
 
 
-def _chunk_count(batch: int) -> int:
-    """How many 16-image chunks to split ``batch`` into (0 = monolithic)."""
-    if batch % _CHUNK == 0 and _CHUNK_MIN <= batch <= _CHUNK_MAX:
-        return batch // _CHUNK
-    return 0
+def _chunk_sizes(batch: int) -> list[int] | None:
+    """Chunk sizes to split ``batch`` into, or None for monolithic."""
+    if batch % _TAIL or not _CHUNK_MIN <= batch <= _CHUNK_MAX:
+        return None
+    k, r = divmod(batch, _CHUNK)
+    sizes = [_CHUNK] * k + ([r] if r else [])
+    return sizes if len(sizes) > 1 else None
 
 
 def build_fast_forward(
@@ -73,11 +79,12 @@ def build_fast_forward(
     The caller (models.build_forward) handles uint8 normalization and the
     final f32 cast, exactly as for the flax path.
 
-    ``chunk`` (default on) runs 16-multiple batches in [32, 64] (i.e.
-    32/48/64; 56 stays monolithic) as unrolled 16-image microbatches
+    ``chunk`` (default on) runs 8-multiple batches in [32, 64] (i.e.
+    32/40/48/56/64; 40 and 56 take a trailing 8-image chunk) as unrolled
+    16-image microbatches
     inside the same program, which sidesteps XLA's worse
     entry-flow schedules at those sizes (+9-19% device throughput,
-    exp/chunked_forward.py; see ``_chunk_count``).  Per-image numerics are
+    exp/chunked_forward.py; see ``_chunk_sizes``).  Per-image numerics are
     those of the batch-16 program -- same bf16-noise tolerance vs flax.
     Off for the experimental entry-kernel paths so their measurements stay
     monolithic and attributable.
@@ -294,12 +301,12 @@ def build_fast_forward(
         )
 
     def forward(variables, x):
-        k = _chunk_count(x.shape[0]) if chunk and not entry_kernel else 0
-        if k:
-            outs = [
-                forward_one(variables, x[i * _CHUNK : (i + 1) * _CHUNK])
-                for i in range(k)
-            ]
+        sizes = _chunk_sizes(x.shape[0]) if chunk and not entry_kernel else None
+        if sizes:
+            outs, lo = [], 0
+            for n in sizes:
+                outs.append(forward_one(variables, x[lo : lo + n]))
+                lo += n
             return jnp.concatenate(outs, axis=0)
         return forward_one(variables, x)
 

@@ -243,12 +243,18 @@ class InferenceEngine:
     def ready(self) -> bool:
         return self._ready.is_set()
 
-    def warmup(self) -> float:
+    def warmup(self, workers: int = 4) -> float:
         """Compile every bucket shape; gate readiness on completion.
 
         The reference has no readiness probes, so a cold TF-Serving pod can
         receive traffic before the model loads (SURVEY.md section 5); here
         k8s readiness is wired to this warmup being done.
+
+        Buckets compile CONCURRENTLY (``workers`` threads): jax.jit is
+        thread-safe and XLA releases the GIL while compiling, so cold-start
+        wall time approaches the slowest bucket's compile rather than the
+        sum -- which matters since the chunked 32/64 bucket programs
+        compile in minutes each (models/xception_fast.py round 4).
 
         If a bucket fails to compile on the fused fast path (a Mosaic
         legality regression at some shape), the engine degrades to the exact
@@ -256,32 +262,66 @@ class InferenceEngine:
         (round-2's failure mode: the default TPU config could not boot).
         """
         t0 = time.perf_counter()
-        pending = list(self.buckets)
-        retried = False
-        while pending:
-            b = pending[0]
-            x = np.zeros((b, *self.spec.input_shape), np.uint8)
-            try:
-                np.asarray(self._jitted(self._variables, x))  # block: compile+run
-            except Exception as exc:  # noqa: BLE001 - compile errors vary by backend
-                # One retry first: a deterministic Mosaic/lowering failure
-                # fails again immediately, but a transient runtime error
-                # (device busy, brief HBM pressure from a neighbor) must not
-                # lock a healthy pod onto the slower exact graph for life.
-                if not retried:
-                    retried = True
-                    continue
-                if not self._degrade_fast(b, exc):
-                    raise
-                pending = list(self.buckets)  # re-warm all on the exact graph
-                retried = False  # the exact graph gets its own retry budget
-                continue
-            pending.pop(0)
-            retried = False
+        while True:
+            failure = self._warm_buckets(max(1, workers))
+            if failure is None:
+                break
+            bucket, exc = failure
+            if not self._degrade_fast(bucket, exc):
+                raise exc
+            # Degraded: loop re-warms every bucket on the exact graph,
+            # with its own per-bucket retry budget.
         dt = time.perf_counter() - t0
         self._m_warmup.set(dt)
         self._ready.set()
         return dt
+
+    def _warm_buckets(self, workers: int) -> tuple[int, Exception] | None:
+        """Compile+run every bucket, ``workers`` at a time; returns the
+        first persistently-failing (bucket, exception) or None.
+
+        Each bucket gets one retry: a deterministic Mosaic/lowering failure
+        fails again immediately, but a transient runtime error (device
+        busy, brief HBM pressure from a neighbor) must not lock a healthy
+        pod onto the slower exact graph for life.  Retries run SERIALLY
+        after the pool has drained -- retrying while sibling warmup threads
+        still compile/execute would re-create the very contention that
+        caused a transient failure and convert it into a permanent
+        degrade.  A persistent failure still lets in-flight sibling
+        compiles finish before returning (wasted only in the rare
+        fail-then-degrade boot, and compile failures typically raise in
+        seconds at lowering, not after minutes).
+        """
+
+        def warm_one(b: int) -> None:
+            x = np.zeros((b, *self.spec.input_shape), np.uint8)
+            np.asarray(self._jitted(self._variables, x))  # compile+run
+
+        failures: list[tuple[int, Exception]] = []
+        if workers == 1 or len(self.buckets) == 1:
+            for b in self.buckets:
+                try:
+                    warm_one(b)
+                except Exception as exc:  # noqa: BLE001 - vary by backend
+                    failures.append((b, exc))
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                max_workers=min(workers, len(self.buckets))
+            ) as ex:
+                futures = [(b, ex.submit(warm_one, b)) for b in self.buckets]
+                for b, fut in futures:
+                    try:
+                        fut.result()
+                    except Exception as exc:  # noqa: BLE001
+                        failures.append((b, exc))
+        for b, _first_exc in failures:  # serial second chance, quiet device
+            try:
+                warm_one(b)
+            except Exception as exc:  # noqa: BLE001
+                return b, exc
+        return None
 
     def _degrade_fast(self, bucket: int, exc: Exception) -> bool:
         """Swap the forward to the exact flax graph after a fast-path

@@ -226,16 +226,19 @@ def test_fast_forward_matches_flax(fast_spec):
     assert rel < 1e-2, f"fast path diverges from flax graph: {rel:.2e}"
 
 
-def test_chunk_count_rules():
-    """Microbatch chunking engages exactly for 16-multiples in [32, 64]
-    (measured win zone, exp/chunked_forward.py); everything else monolithic."""
-    from kubernetes_deep_learning_tpu.models.xception_fast import _chunk_count
+def test_chunk_size_rules():
+    """Microbatch chunking engages exactly for 8-multiples in [32, 64]
+    (measured win zone, exp/chunked_forward.py); everything else
+    monolithic.  Non-16-multiples take a trailing 8-chunk."""
+    from kubernetes_deep_learning_tpu.models.xception_fast import _chunk_sizes
 
-    assert _chunk_count(32) == 2
-    assert _chunk_count(48) == 3
-    assert _chunk_count(64) == 4
-    for n in (1, 8, 16, 24, 56, 96, 128, 256):
-        assert _chunk_count(n) == 0, n
+    assert _chunk_sizes(32) == [16, 16]
+    assert _chunk_sizes(40) == [16, 16, 8]
+    assert _chunk_sizes(48) == [16, 16, 16]
+    assert _chunk_sizes(56) == [16, 16, 16, 8]
+    assert _chunk_sizes(64) == [16, 16, 16, 16]
+    for n in (1, 8, 16, 24, 36, 96, 128, 256):
+        assert _chunk_sizes(n) is None, n
 
 
 def test_chunked_fast_forward_matches_monolithic(fast_spec, monkeypatch):
@@ -247,6 +250,7 @@ def test_chunked_fast_forward_matches_monolithic(fast_spec, monkeypatch):
     from kubernetes_deep_learning_tpu.ops.preprocess import normalize
 
     monkeypatch.setattr(xception_fast, "_CHUNK", 1)
+    monkeypatch.setattr(xception_fast, "_TAIL", 1)
     monkeypatch.setattr(xception_fast, "_CHUNK_MIN", 2)
     monkeypatch.setattr(xception_fast, "_CHUNK_MAX", 2)
 
