@@ -1,0 +1,114 @@
+"""Why is flash attention 46% of ViT-B's device time, and what fixes it?
+
+Round-4 trace (exp/batch_dip_trace.py --model vit-b16-imagenet): each of
+the 12 flash custom calls costs 0.64-0.66 ms/iter at batch 32 -- ~5% MFU
+-- and switching the in-kernel dots from f32 to bf16 changed NOTHING, so
+the kernel is grid-overhead-bound (384 x 2 steps of ~4 MFLOP each, ~1.7
+us/step), not MXU-rate-bound.
+
+Measures device span (profiler trace) of attention variants at ViT-B
+serving shape (B=32, H=12, S=256, D=64, bf16):
+
+- flash-128: the shipped kernel (block_q=128, grid (384, 2))
+- flash-256: block_q=256 (grid (384, 1): half the steps)
+- einsum:    mha_reference (XLA path: materializes (B,H,S,S) scores)
+
+Usage: python exp/vit_attn_variants.py [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def device_span_ms(fn, args_, iters: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn(*args_))  # compile
+    trace_dir = tempfile.mkdtemp(prefix="kdlt-attnvar-")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(iters):
+            jax.block_until_ready(fn(*args_))
+    files = glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    )
+    assert files, f"no trace files under {trace_dir}"
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+    pids = {}
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pids[ev["pid"]] = ev["args"].get("name", "")
+    dev = {p for p, n in pids.items() if n.startswith("/device:TPU")}
+    total = 0.0
+    for ev in trace["traceEvents"]:
+        if (
+            ev.get("ph") == "X"
+            and ev.get("pid") in dev
+            and not ev.get("name", "").startswith("jit_")
+        ):
+            total += ev.get("dur", 0) / 1e3
+    return total / iters
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--seq", type=int, default=256)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--iters", type=int, default=30)
+    args = p.parse_args()
+
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubernetes_deep_learning_tpu.ops import attention
+
+    rng = np.random.default_rng(0)
+    shape = (args.batch, args.heads, args.seq, args.dim)
+    q, k, v = (
+        jax.device_put(rng.normal(0, 1, shape).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        for _ in range(3)
+    )
+
+    ref = jax.jit(attention.mha_reference)
+    want = np.asarray(ref(q, k, v), np.float32)
+    flops = 2 * 2 * args.batch * args.heads * args.seq * args.seq * args.dim
+
+    variants = [
+        ("flash-128x128", jax.jit(functools.partial(attention.flash_attention, block_q=128))),
+        ("flash-256x128", jax.jit(functools.partial(attention.flash_attention, block_q=256))),
+        # What pick_block actually ships for 256-multiple S: 256 on BOTH
+        # sides (callers pass one block to block_q and block_k alike).
+        ("flash-256x256", jax.jit(functools.partial(
+            attention.flash_attention, block_q=256, block_k=256))),
+        ("einsum", ref),
+    ]
+    print(f"B={args.batch} H={args.heads} S={args.seq} D={args.dim} bf16; "
+          f"{flops / 1e9:.2f} GFLOP per attention")
+    for name, fn in variants:
+        got = np.asarray(fn(q, k, v), np.float32)
+        rel = float(np.abs(got - want).max() / (np.abs(want).max() + 1e-9))
+        ms = device_span_ms(fn, (q, k, v), args.iters)
+        print(
+            f"{name:10s}  {ms:7.3f} ms  {flops / ms / 1e9:6.1f} GFLOP/s"
+            f"  max-rel {rel:.1e}"
+        )
+
+
+if __name__ == "__main__":
+    main()

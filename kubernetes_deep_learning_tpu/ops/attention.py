@@ -41,14 +41,19 @@ def _causal_mask(q_offset: int, k_offset, block_q: int, block_k: int):
 
 
 def pick_block(seq: int) -> int | None:
-    """Largest MXU-friendly flash block (<=128, 8-aligned) dividing ``seq``.
+    """Largest MXU-friendly flash block (<=256, 8-aligned) dividing ``seq``.
 
     None means no legal tiling exists for ``seq`` AS IS; callers should go
     through ``flash_attention_padded`` (pad + kv_len masking) rather than
     falling back to the einsum path.  Single source of the kernel's tiling
     rule -- consumed by flash_attention_padded and parallel.ring.
+
+    256 leads: fewer, fatter grid steps and k-iterations measured
+    2.2-2.5x faster than 128x128 blocks at every swept S -- fast enough
+    to beat even the einsum path at S=1024 (the kernel is
+    per-step-overhead-bound at D=64; exp/vit_attn_variants.py, round 4).
     """
-    for block in (128, 64, 32, 16, 8):
+    for block in (256, 128, 64, 32, 16, 8):
         if seq % block == 0:
             return block
     return None
@@ -129,7 +134,15 @@ def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset, kv_len=None):
     to a block multiple -- e.g. ViT's 257 tokens padded to 264); columns at
     or beyond it are masked to -inf so pad keys never enter the softmax.
     """
-    q = q_ref[0].astype(jnp.float32)          # (block_q, d)
+    # Dots run on the INPUT dtype with f32 accumulation
+    # (preferred_element_type): for bf16 serving inputs that's the MXU's
+    # full bf16 rate -- upcasting operands to f32 ran the dots as multi-pass
+    # f32 MXU ops at ~1/4 rate, which made this kernel 46% of ViT-B's
+    # device time at ~5% MFU (exp/batch_dip_trace.py --model
+    # vit-b16-imagenet, round 4).  Softmax statistics stay f32 throughout;
+    # f32 inputs keep exact f32 dots (tests, exact paths).
+    q = q_ref[0]                              # (block_q, d), input dtype
+    in_dtype = q.dtype
     block_q, d = q.shape
     seq_k = k_ref.shape[1]
     num_k = seq_k // block_k
@@ -138,13 +151,13 @@ def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset, kv_len=None):
 
     def body(j, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                              # (block_q, block_k)
+        ) * scale                              # (block_q, block_k) f32
         if kv_len is not None:
             cols = (
                 jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -158,8 +171,10 @@ def _flash_body(q_ref, k_ref, v_ref, *, block_k, causal, k_offset, kv_len=None):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p in [0, 1] cast to the input dtype for the PV dot (bf16 MXU
+        # rate; standard flash practice), f32 accumulate.
         acc = acc * alpha + jax.lax.dot_general(
-            p, v_blk,
+            p.astype(in_dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -359,6 +374,48 @@ def flash_attention_padded(q, k, v, *, causal: bool = False,
         interpret=interpret, kv_len=s,
     )
     return out[:, :, :s, :]
+
+
+# Sequence length up to which inference routes to the einsum path.  Not a
+# perf crossover -- einsum never lost to the kernel in the round-4 sweep
+# (6.5x faster at ViT-B's (32,12,256,64), still 1.4x at S=1024, because
+# D=64 heads give each flash grid step only ~4 MFLOP of work against
+# ~1.7 us of fixed per-step cost) -- but an HBM-comfort bound on the
+# (B, H, S, S) f32 scores it materializes: <=1.6 GiB at the largest
+# default bucket (128) for ViT-B.  Sequence-only (not batch) so the rule
+# stays decidable under the exporter's SYMBOLIC batch dimension and every
+# bucket of one artifact routes identically.
+EINSUM_MAX_SEQ = 512
+
+
+def use_einsum_attention(sq: int, sk: int) -> bool:
+    """Trace-time routing rule for ``attention_serving`` (pure, testable)."""
+    return sq <= EINSUM_MAX_SEQ and sk <= EINSUM_MAX_SEQ
+
+
+def attention_serving(q, k, v, *, causal: bool = False):
+    """Inference MHA with measured shape routing (round 4).
+
+    Short/serving-scale sequences take the einsum path: materializing the
+    f32 score matrix in HBM costs far less than the flash kernel's
+    per-grid-step overhead (see ``EINSUM_MAX_SEQ``).  Beyond
+    the sequence budget -- long-context, ring-attention shards -- the
+    fused kernel takes over: that memory wall is what it exists for.  The
+    kernel branch resolves per LOWERING platform (the exporter traces one
+    module for cpu and tpu; a trace-time backend check would bake the
+    wrong mode into one of them), while the einsum branch is
+    platform-portable as-is.
+    """
+    sq, sk = q.shape[2], k.shape[2]
+    if use_einsum_attention(sq, sk) or not _HAVE_PALLAS:
+        return mha_reference(q, k, v, causal=causal)
+    return jax.lax.platform_dependent(
+        q, k, v,
+        tpu=functools.partial(
+            flash_attention_padded, causal=causal, interpret=False
+        ),
+        default=functools.partial(mha_reference, causal=causal),
+    )
 
 
 # --- trainable memory-efficient attention ----------------------------------

@@ -177,3 +177,62 @@ def test_flash_attention_padded_ragged_seq(seq, causal):
     got = np.asarray(flash_attention_padded(q, k, v, causal=causal))
     want = np.asarray(mha_reference(q, k, v, causal=causal))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_attention_serving_routing_and_equivalence():
+    """Shape routing (round 4): einsum while S <= EINSUM_MAX_SEQ, flash
+    past it; the einsum route must be exactly mha_reference."""
+    import numpy as np
+
+    from kubernetes_deep_learning_tpu.ops.attention import (
+        EINSUM_MAX_SEQ,
+        attention_serving,
+        mha_reference,
+        use_einsum_attention,
+    )
+
+    assert use_einsum_attention(256, 256)
+    assert use_einsum_attention(EINSUM_MAX_SEQ, EINSUM_MAX_SEQ)
+    assert not use_einsum_attention(EINSUM_MAX_SEQ + 8, EINSUM_MAX_SEQ)
+    assert not use_einsum_attention(1024, 1024)
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 2, 16, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    got = np.asarray(attention_serving(q, k, v))
+    want = np.asarray(mha_reference(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+
+
+def test_flash_attention_bf16_dots_match_reference():
+    """The bf16 in-kernel dot path (the dtype production serving runs --
+    f32 softmax statistics, bf16 MXU operands, round 4) must stay at
+    bf16-noise distance from the f32 reference on the same data."""
+    import numpy as np
+
+    from kubernetes_deep_learning_tpu.ops.attention import (
+        flash_attention,
+        mha_reference,
+    )
+
+    rng = np.random.default_rng(7)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 3, 256, 64)), jnp.float32)
+        for _ in range(3)
+    )
+    want = np.asarray(mha_reference(q, k, v), np.float32)
+    got = np.asarray(
+        flash_attention(
+            q.astype(jnp.bfloat16),
+            k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16),
+            block_q=128,
+            block_k=128,
+            interpret=True,
+        ),
+        np.float32,
+    )
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+    assert rel < 2e-2, f"bf16 flash dots diverge from f32 reference: {rel:.2e}"

@@ -48,11 +48,11 @@ def test_flash_and_reference_attention_agree(tiny_vit_spec):
     np.testing.assert_allclose(np.asarray(infer), np.asarray(train), atol=1e-4)
 
 
-def test_vit_exports_per_platform_and_serves(tiny_vit_spec, tmp_path):
-    # The platform_dependent flash branch cannot co-lower into one
-    # cpu+tpu module (every branch is kept in multi-platform modules), so
-    # export_model must fall back to one module per platform, and the
-    # engine must pick its device's module at load.
+def test_vit_short_seq_exports_portable_and_serves(tiny_vit_spec, tmp_path):
+    # Since the round-4 shape routing, short-S ViTs (S <= EINSUM_MAX_SEQ)
+    # run the platform-portable einsum attention, so export emits ONE
+    # portable module -- no per-platform fallback needed -- and the engine
+    # serves it.
     import os
 
     from kubernetes_deep_learning_tpu.export import artifact as art
@@ -62,18 +62,50 @@ def test_vit_exports_per_platform_and_serves(tiny_vit_spec, tmp_path):
     variables = init_variables(tiny_vit_spec, seed=0)
     directory = export_model(tiny_vit_spec, variables, str(tmp_path))
     files = set(os.listdir(directory))
-    assert art.platform_module_file("cpu") in files
-    assert art.platform_module_file("tpu") in files
-    assert art.MODULE_FILE not in files
+    assert art.MODULE_FILE in files
 
     a = art.load_artifact(directory)
-    assert a.metadata["module_layout"] == "per-platform"
-    assert a.module_bytes_for("cpu") is not None
     engine = InferenceEngine(a, buckets=(1, 2), use_exported=True)
     engine.warmup()
     out = engine.predict(np.zeros((2, *tiny_vit_spec.input_shape), np.uint8))
     assert out.shape == (2, tiny_vit_spec.num_classes)
     assert np.all(np.isfinite(out))
+
+
+def test_vit_long_seq_exports_per_platform(tmp_path):
+    # Past the einsum sequence budget the flash branch is back in the
+    # traced module; its platform_dependent cannot co-lower into one
+    # cpu+tpu module, so export_model must fall back to one module per
+    # platform, and the artifact must load with the per-platform layout.
+    import os
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.export.exporter import export_model
+    from kubernetes_deep_learning_tpu.ops.attention import EINSUM_MAX_SEQ
+
+    spec = register_spec(
+        ModelSpec(
+            name="tiny-vit-long",
+            family="vit-tiny",
+            # patch 8 -> (256/8)^2 = 1024 tokens > EINSUM_MAX_SEQ: the
+            # serving attention routes to the flash kernel.
+            input_shape=(256, 256, 3),
+            labels=("a", "b"),
+            preprocessing="tf",
+            description="test-only long-sequence vit (1024 tokens)",
+        )
+    )
+    assert (256 // 8) ** 2 > EINSUM_MAX_SEQ
+
+    variables = init_variables(spec, seed=0)
+    directory = export_model(spec, variables, str(tmp_path))
+    files = set(os.listdir(directory))
+    assert art.platform_module_file("cpu") in files
+    assert art.platform_module_file("tpu") in files
+    assert art.MODULE_FILE not in files
+    a = art.load_artifact(directory)
+    assert a.metadata["module_layout"] == "per-platform"
+    assert a.module_bytes_for("cpu") is not None
 
 
 def test_vit_b16_structure():
