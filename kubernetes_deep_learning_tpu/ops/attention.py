@@ -354,26 +354,30 @@ def flash_attention_padded(q, k, v, *, causal: bool = False,
     reference and materialized the (S, S) score matrix in HBM.  Pad-query
     rows are zeros; their outputs are garbage-free (finite) and sliced off.
     """
-    s = q.shape[2]
-    block = pick_block(s)
-    if block is not None:
+    sq, sk = q.shape[2], k.shape[2]
+    block_q, block_k = pick_block(sq), pick_block(sk)
+    if block_q is not None and block_k is not None:
         return flash_attention(
-            q, k, v, causal=causal, block_q=block, block_k=block,
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
             interpret=interpret,
         )
     # Pad to a multiple of 128, NOT the minimal 8: pick_block(next-8-
     # multiple) would tile the MXU at 8x8 for most ragged lengths (e.g.
     # 257 -> 264 -> block 8), wasting ~15/16 of every pass.  The extra pad
-    # rows are masked by kv_len and cost <=127 rows of FLOPs.
-    sp = -(-s // 128) * 128
-    block = pick_block(sp)
-    pad = ((0, 0), (0, 0), (0, sp - s), (0, 0))
+    # rows are masked by kv_len and cost <=127 rows of FLOPs.  Query and
+    # KV pad INDEPENDENTLY: cross-attention arrives with sq != sk, and a
+    # q-derived pad on k either misaligns or crashes the kernel's
+    # divisibility check.
+    sqp = -(-sq // 128) * 128
+    skp = -(-sk // 128) * 128
+    pad_q = ((0, 0), (0, 0), (0, sqp - sq), (0, 0))
+    pad_k = ((0, 0), (0, 0), (0, skp - sk), (0, 0))
     out = flash_attention(
-        jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-        causal=causal, block_q=block, block_k=block,
-        interpret=interpret, kv_len=s,
+        jnp.pad(q, pad_q), jnp.pad(k, pad_k), jnp.pad(v, pad_k),
+        causal=causal, block_q=pick_block(sqp), block_k=pick_block(skp),
+        interpret=interpret, kv_len=sk if skp != sk else None,
     )
-    return out[:, :, :s, :]
+    return out[:, :, :sq, :]
 
 
 # Sequence length up to which inference routes to the einsum path.  Not a

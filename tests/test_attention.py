@@ -236,3 +236,28 @@ def test_flash_attention_bf16_dots_match_reference():
     )
     rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
     assert rel < 2e-2, f"bf16 flash dots diverge from f32 reference: {rel:.2e}"
+
+
+def test_flash_attention_padded_cross_attention_ragged():
+    """sq != sk must pad each side independently (a q-derived pad on k
+    either misaligns or crashes the kernel's divisibility check)."""
+    import numpy as np
+
+    from kubernetes_deep_learning_tpu.ops.attention import (
+        flash_attention_padded,
+        mha_reference,
+    )
+
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((1, 2, 250, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 520, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 520, 16)), jnp.float32)
+    got = np.asarray(flash_attention_padded(q, k, v, interpret=True))
+    want = np.asarray(mha_reference(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    # Tileable-but-unequal lengths take the unpadded fast exit.
+    q2 = jnp.asarray(rng.standard_normal((1, 2, 512, 16)), jnp.float32)
+    got2 = np.asarray(flash_attention_padded(q2, k[:, :, :640], v[:, :, :640], interpret=True))
+    want2 = np.asarray(mha_reference(q2, k[:, :, :640], v[:, :, :640]))
+    np.testing.assert_allclose(got2, want2, rtol=2e-4, atol=2e-4)

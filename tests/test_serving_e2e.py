@@ -357,3 +357,51 @@ def test_request_id_minted_and_sanitized(stack):
     )
     assert r.headers[REQUEST_ID_HEADER] == "abcX-Injected1DEF"
     assert "X-Injected" not in r.headers
+
+
+def test_second_model_hot_added_and_served(stack):
+    """A NEW model dropped under the model root is discovered by the same
+    scan the version watcher and the gRPC reload RPC share, warmed before
+    the swap, and served ALONGSIDE the original -- the multi-model surface
+    of the TF-Serving convention, which the reference's one-model-per-image
+    flow never exercises (reference tf-serving.dockerfile:5)."""
+    import urllib.request
+
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.serving import protocol
+
+    spec, server, gateway, image_url, pixels, variables = stack
+    vit = register_spec(
+        ModelSpec(
+            name="e2e-vit",
+            family="vit-tiny",
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+        )
+    )
+    export_model(vit, init_variables(vit, seed=1), server.model_root)
+    updated = server.poll_versions()
+    assert any("e2e-vit" in u for u in updated), updated
+    assert "e2e-vit" in server.models and server.ready
+
+    img = np.zeros((2, 32, 32, 3), np.uint8)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/v1/models/e2e-vit:predict",
+        data=protocol.encode_predict_request(img),
+        headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+    )
+    resp = urllib.request.urlopen(req, timeout=60)
+    logits, labels = protocol.decode_predict_response(
+        resp.read(), resp.headers["Content-Type"]
+    )
+    assert logits.shape == (2, 3) and list(labels) == ["a", "b", "c"]
+    assert np.all(np.isfinite(logits))
+
+    # The original model keeps serving from the same process.
+    out_logits, out_labels = predict_images(
+        f"http://localhost:{server.port}", spec.name,
+        np.zeros((1, 96, 96, 3), np.uint8),
+    )
+    assert out_logits.shape == (1, spec.num_classes)
+    assert out_labels == list(spec.labels)

@@ -107,6 +107,16 @@ def test_vit_long_seq_exports_per_platform(tmp_path):
     assert a.metadata["module_layout"] == "per-platform"
     assert a.module_bytes_for("cpu") is not None
 
+    # The engine must pick its device's module at load and serve from it
+    # (CPU here -> the einsum branch of the platform-dependent module).
+    from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
+
+    engine = InferenceEngine(a, buckets=(1,), use_exported=True)
+    engine.warmup()
+    out = engine.predict(np.zeros((1, *spec.input_shape), np.uint8))
+    assert out.shape == (1, spec.num_classes)
+    assert np.all(np.isfinite(out))
+
 
 def test_vit_b16_structure():
     spec = get_spec("vit-b16-imagenet")
