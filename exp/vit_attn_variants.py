@@ -9,9 +9,14 @@ us/step), not MXU-rate-bound.
 Measures device span (profiler trace) of attention variants at ViT-B
 serving shape (B=32, H=12, S=256, D=64, bf16):
 
-- flash-128: the shipped kernel (block_q=128, grid (384, 2))
-- flash-256: block_q=256 (grid (384, 1): half the steps)
-- einsum:    mha_reference (XLA path: materializes (B,H,S,S) scores)
+- flash-128x128: the round-3 kernel tiling (block_q=128, block_k=128)
+- flash-256x128: block_q=256 only (half the grid steps)
+- flash-256x256: what pick_block ships since round 4 (256 both sides)
+- flash-g4/g8:   G-folded local kernel (see flash_gfold): g (batch, head)
+                 pairs per grid step -- wins 1.4x more at S=256 but is
+                 within noise of 256x256 at S>=1024, where flash actually
+                 ships (serving routes S<=512 to einsum); not shipped
+- einsum:        mha_reference (XLA path: materializes (B,H,S,S) scores)
 
 Usage: python exp/vit_attn_variants.py [--batch 32]
 """
@@ -59,6 +64,75 @@ def device_span_ms(fn, args_, iters: int) -> float:
     return total / iters
 
 
+def flash_gfold(q, k, v, *, g: int, block_q: int = 256, block_k: int = 256):
+    """G-folded flash: ``g`` (batch, head) pairs per grid step.
+
+    The shipped kernel's grid iterates every (b*h, q-tile) pair, and at
+    D=64 each step carries so little work that fixed per-step cost
+    dominates (ROADMAP "flash forward at D=64 remains overhead-bound").
+    Folding g pairs into one step multiplies per-step work by g and cuts
+    steps by g; the in-kernel body just loops over the fold (python
+    unroll).  Non-causal only -- the serving/ring forward regime.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    assert bh % g == 0 and sq % block_q == 0 and sk % block_k == 0
+    qf = q.reshape(bh, sq, d)
+    kf = k.reshape(bh, sk, d)
+    vf = v.reshape(bh, sk, d)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        scale = 1.0 / math.sqrt(d)
+        num_k = sk // block_k
+        for gi in range(g):
+            qg = q_ref[gi]                       # (block_q, d)
+
+            def body(j, carry):
+                acc, m, l = carry
+                k_blk = k_ref[gi, pl.ds(j * block_k, block_k), :]
+                v_blk = v_ref[gi, pl.ds(j * block_k, block_k), :]
+                s = jax.lax.dot_general(
+                    qg, k_blk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m - m_new)
+                l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                acc = acc * alpha + jax.lax.dot_general(
+                    p.astype(qg.dtype), v_blk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                return acc, m_new, l
+
+            acc = jnp.zeros((block_q, d), jnp.float32)
+            m = jnp.full((block_q, 1), -1e30, jnp.float32)
+            l = jnp.zeros((block_q, 1), jnp.float32)
+            acc, m, l = jax.lax.fori_loop(0, num_k, body, (acc, m, l))
+            o_ref[gi] = (acc / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh // g, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((g, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((g, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((g, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((g, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=jax.devices()[0].platform != "tpu",
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=32)
@@ -98,6 +172,15 @@ def main() -> None:
             attention.flash_attention, block_q=256, block_k=256))),
         ("einsum", ref),
     ]
+    for g in (4, 8):
+        if (args.batch * args.heads) % g == 0:
+            variants.insert(
+                -1,
+                (
+                    f"flash-g{g}",
+                    jax.jit(functools.partial(flash_gfold, g=g)),
+                ),
+            )
     print(f"B={args.batch} H={args.heads} S={args.seq} D={args.dim} bf16; "
           f"{flops / 1e9:.2f} GFLOP per attention")
     for name, fn in variants:
