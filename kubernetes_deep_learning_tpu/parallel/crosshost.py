@@ -216,6 +216,13 @@ class CrossHostForward:
         -- matching single-host serving's warmup degrade
         (runtime.engine._degrade_fast) but decided once, fleet-wide,
         BEFORE followers would trace the same program mid-round.
+
+        Buckets compile CONCURRENTLY, like engine warmup (XLA releases
+        the GIL while compiling; the chunked 32-64 bucket programs take
+        ~1-3 min each, runtime.engine.warmup round 4), so the probe costs
+        about the slowest bucket's compile rather than the sum.  Lowering
+        (tracing) stays serial -- it is Python-side and cheap; only the
+        ``.compile()`` calls fan out.
         """
         import jax
 
@@ -223,12 +230,35 @@ class CrossHostForward:
             return self.mode
         try:
             fn = self._fast_jitted()
+            lowered = {}
             for b in self.buckets:
                 x = jax.ShapeDtypeStruct(
                     (b, *self.spec.input_shape), np.uint8,
                     sharding=self._batch_sharding,
                 )
-                self._fast_aot[b] = fn.lower(self._variables, x).compile()
+                lowered[b] = fn.lower(self._variables, x)
+            from concurrent.futures import ThreadPoolExecutor
+
+            aot = {}
+            failed = []
+            with ThreadPoolExecutor(
+                max_workers=min(4, len(self.buckets))
+            ) as ex:
+                futures = {
+                    b: ex.submit(low.compile) for b, low in lowered.items()
+                }
+                for b, fut in futures.items():
+                    try:
+                        aot[b] = fut.result()
+                    except Exception:  # noqa: BLE001 - vary by backend
+                        failed.append(b)
+            # Serial second chance after the pool drains, mirroring
+            # runtime.engine._warm_buckets: a transient error caused by the
+            # sibling compiles' own contention must not degrade a healthy
+            # fleet to the exact graph for the process lifetime.
+            for b in failed:
+                aot[b] = lowered[b].compile()
+            self._fast_aot = aot
             self.mode = "fast"
         except Exception as exc:  # noqa: BLE001 - compile errors vary by backend
             import logging
