@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -55,6 +56,140 @@ TARGET_P50_MS = 15.0   # ...at p50 <= 15 ms (the north star's latency bound)
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+class SweepTerminated(Exception):
+    """Raised by the SIGTERM handler so a driver-killed sweep still lands
+    on the final-headline print instead of dying mid-point (VERDICT r4 #1:
+    rc=124 with zero parsable output nullified the round-4 record)."""
+
+
+def _sigterm_handler(signum, frame):  # noqa: ARG001 - signal signature
+    raise SweepTerminated(f"signal {signum}")
+
+
+def _env_float(name: str, default: float) -> float:
+    """Parse a float env override; a typo'd value must degrade to the
+    default, not kill the process before it can emit any record."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        log(f"{name}={os.environ[name]!r} is not a number; using {default}")
+        return default
+
+
+def compose_headline(model, dtype, params_dtype, results, faults, flops_img,
+                     *, dropped=(), terminated=False, points_total=None):
+    """Build the one-line official-record JSON from whatever points exist.
+
+    Called after EVERY completed batch point, not just at sweep end, so the
+    last stdout line of a truncated run (driver timeout, SIGTERM, OOM kill)
+    is always a parsable record of the best measurement so far -- later
+    emissions overwrite earlier ones in the driver's last-line parse.
+    Returns (out_dict, rc).
+    """
+    if not results:
+        if terminated and not faults:
+            why = ("sweep terminated by signal before any batch point "
+                   "completed; no measurements")
+        elif terminated:
+            why = ("sweep terminated by signal; every attempted batch "
+                   "point had faulted, see faults")
+        else:
+            why = "EVERY batch point faulted; no surviving measurements"
+        out = {
+            "metric": f"{model} images/sec/chip ({why})",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "faults": faults,
+        }
+        if terminated:
+            out["terminated"] = True
+        if dropped:
+            out["dropped_points"] = list(dropped)
+        return out, 1
+
+    # Headline: the north star is ">=4000 img/s/chip at p50 <= 15 ms"
+    # (BASELINE.json) -- the best MIN-of-both-methods throughput among batch
+    # sizes that MEET the latency bound AND pass the physics check
+    # (MFU <= 100% when peak is known).  Full sweep is in the "sweep" field;
+    # faulted points are in "faults" (nothing hidden -- a fault zeroes one
+    # point, not the record).
+    def valid(r):
+        return r["mfu_pct"] is None or r["mfu_pct"] <= 100.0
+
+    valid_pool = {b: r for b, r in results.items() if valid(r)}
+    eligible = {
+        b: r for b, r in valid_pool.items() if r["p50_ms"] <= TARGET_P50_MS
+    }
+    pool = eligible or valid_pool or results
+    headline_batch = max(pool, key=lambda b: pool[b]["img_per_s"])
+    r = results[headline_batch]
+    value = r["img_per_s"]
+    if not valid_pool:
+        bound_note = (
+            "INVALID: every batch failed the MFU<=100% physics check; "
+            "number is not trustworthy"
+        )
+    elif headline_batch in eligible:
+        bound_note = f"within p50<={TARGET_P50_MS:.0f}ms bound"
+    else:
+        bound_note = (
+            f"NO valid batch met the p50<={TARGET_P50_MS:.0f}ms bound; "
+            "best valid overall"
+        )
+    fault_note = f"; {len(faults)} faulted point attempt(s), see faults" if faults else ""
+    progress_note = ""
+    if points_total is not None and len(results) < points_total:
+        progress_note = f"; partial sweep {len(results)}/{points_total} points"
+        if terminated:
+            progress_note += " (terminated by signal)"
+        elif dropped:
+            progress_note += " (budget trimmed)"
+    out = {
+        "metric": f"{model} images/sec/chip (best batch={headline_batch} "
+        f"{bound_note}; min of {r.get('headline_methods', 'scan/pipelined')} "
+        f"methods, agreement={r['method_agreement']:.2f}; device "
+        f"p50={r['p50_ms']:.2f}ms/batch, {dtype} compute, "
+        f"{params_dtype} params"
+        + (f", {flops_img / 1e9:.2f} GFLOPs/img" if flops_img else "")
+        + fault_note
+        + progress_note
+        + ")",
+        "value": round(value, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / TARGET_IMG_S, 3),
+        "mfu_pct": r["mfu_pct"],
+        # Conservative cross-method p50 (max of the two headline methods)
+        # next to the LIKE-FOR-LIKE device-trace pair: trace_p50_ms and
+        # p99_ms come from the same per-iteration trace-span estimator, so
+        # the tail reads against its own median (VERDICT r4 weak-4: the
+        # old table paired cross-method p50 with trace p99 and inverted on
+        # every row).
+        "p50_ms": round(r["p50_ms"], 2),
+        "p50_source": "cross-method-max",
+        "trace_p50_ms": (
+            round(r["trace_p50_ms"], 2) if r.get("trace_p50_ms") is not None else None
+        ),
+        "p99_ms": round(r["p99_ms"], 2) if r.get("p99_ms") is not None else None,
+        "p99_source": r.get("p99_source"),
+        "sweep": {
+            str(b): {k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in row.items()}
+            for b, row in sorted(results.items())
+        },
+        "faults": faults,
+    }
+    if dropped:
+        out["dropped_points"] = list(dropped)
+    if terminated:
+        out["terminated"] = True
+    # rc=0 iff the in-bound headline exists: a valid (physics-passing) batch
+    # met the latency bound and survived.  Faults at other points (e.g. the
+    # out-of-bound 256 ceiling probe) are reported but do not nullify
+    # an in-bound record.
+    return out, 0 if (valid_pool and headline_batch in eligible) else 1
 
 
 # Per-chip dense peak (TFLOP/s) for the compute dtype, keyed by substrings of
@@ -385,7 +520,7 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
     return spec, results, flops_img
 
 
-def run_isolated_sweep(args, batch_sizes):
+def run_isolated_sweep(args, batch_sizes, emit=None, state=None):
     """Run each batch point of the forward sweep in its OWN subprocess.
 
     Round-3 postmortem (BENCH_r03.json): the TPU worker process died with a
@@ -397,78 +532,176 @@ def run_isolated_sweep(args, batch_sizes):
     from surviving points.  A faulted point is retried once after a pause
     (the tunnel worker restarts itself); both attempts are recorded.
 
-    Returns (results, faults, flops_img).
+    Round-4 postmortem (BENCH_r04.json, rc=124): fault isolation was not
+    enough -- the DRIVER's wall-clock budget killed the sweep mid-run and
+    the headline JSON, printed only at the end, never appeared.  Three
+    defenses:
+
+    * ``emit`` is called with the running (results, faults, flops_img)
+      after every point, so the caller keeps the last stdout line a
+      parsable current-best headline at all times;
+    * an overall time budget (``--budget-s`` / KDLT_BENCH_BUDGET_S) bounds
+      the run: remaining points are trimmed -- and recorded in ``dropped``
+      -- when the next one probably would not finish, each attempt's child
+      timeout is clamped to the remaining budget, and a retry that no
+      longer fits is skipped;
+    * SIGTERM raises SweepTerminated (installed by main), caught here: the
+      in-flight child is stopped and the partial results survive for a
+      final headline print during the termination grace period.
+
+    Progress is also mirrored into ``state`` (a caller-owned dict) as it
+    happens, so even an exception that escapes this function -- e.g. a
+    second SIGTERM landing inside the except block's cleanup -- leaves the
+    caller holding every completed point.
+
+    Returns (results, faults, flops_img, dropped, terminated).
     """
-    results: dict[int, dict] = {}
-    faults: list[dict] = []
-    flops_img = 0.0
-    for b in batch_sizes:
-        row = None
-        for attempt in (1, 2):
-            cmd = [
-                sys.executable, os.path.abspath(__file__),
-                "--child-batch", str(b),
-                "--model", args.model,
-                "--scan-len", str(args.scan_len),
-                "--reps", str(args.reps),
-                "--dtype", args.dtype,
-                "--params-dtype", args.params_dtype,
-                "--peak-tflops", str(args.peak_tflops),
-            ]
-            if flops_img:
-                cmd += ["--flops-img", repr(flops_img)]
-            fault_msg = None
-            proc = subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+    st = state if state is not None else {}
+    results: dict[int, dict] = st.setdefault("results", {})
+    faults: list[dict] = st.setdefault("faults", [])
+    dropped: list[int] = st.setdefault("dropped", [])
+    st.setdefault("flops_img", 0.0)
+    st.setdefault("terminated", False)
+    t_sweep0 = time.perf_counter()
+    slowest_point_s = 0.0
+    proc = None
+    try:
+        for i, b in enumerate(batch_sizes):
+            elapsed = time.perf_counter() - t_sweep0
+            if args.budget_s and i > 0:
+                # Would starting this point probably blow the budget?  The
+                # estimate is the slowest completed point so far (compile
+                # time dominates and grows with batch; still conservative
+                # enough), floored at 60 s.
+                est = max(60.0, slowest_point_s)
+                if elapsed + est > args.budget_s:
+                    dropped.extend(batch_sizes[i:])
+                    log(
+                        f"budget: {elapsed:.0f}s elapsed + ~{est:.0f}s/point "
+                        f"> {args.budget_s:.0f}s budget -- dropping remaining "
+                        f"points {dropped}"
+                    )
+                    break
+            t_point0 = time.perf_counter()
+            row = None
+            for attempt in (1, 2):
+                # Clamp each attempt's child timeout to the budget REMAINING
+                # at the moment it starts (not once per point: a first
+                # attempt that hangs to its timeout must not grant the
+                # retry that same stale allowance).
+                elapsed = time.perf_counter() - t_sweep0
+                point_timeout = args.point_timeout
+                if args.budget_s:
+                    remaining = args.budget_s - elapsed
+                    if attempt > 1 and remaining < 60.0:
+                        log(
+                            f"batch {b:4d}: retry skipped -- "
+                            f"{remaining:.0f}s of budget left"
+                        )
+                        faults.append({
+                            "batch": b, "attempt": attempt,
+                            "fault": "retry skipped: budget exhausted",
+                        })
+                        break
+                    point_timeout = min(point_timeout, max(120.0, remaining))
+                cmd = [
+                    sys.executable, os.path.abspath(__file__),
+                    "--child-batch", str(b),
+                    "--model", args.model,
+                    "--scan-len", str(args.scan_len),
+                    "--reps", str(args.reps),
+                    "--dtype", args.dtype,
+                    "--params-dtype", args.params_dtype,
+                    "--peak-tflops", str(args.peak_tflops),
+                ]
+                if st["flops_img"]:
+                    cmd += ["--flops-img", repr(st["flops_img"])]
+                fault_msg = None
+                proc = subprocess.Popen(
+                    cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+                )
+                try:
+                    out_b, err_b = proc.communicate(timeout=point_timeout)
+                    timed_out = False
+                except subprocess.TimeoutExpired:
+                    # SIGTERM first, grace, then SIGKILL: a hard kill
+                    # mid-compile can wedge the single-client TPU tunnel.
+                    proc.terminate()
+                    try:
+                        out_b, err_b = proc.communicate(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        out_b, err_b = proc.communicate()
+                    timed_out = True
+                child_rc = proc.returncode
+                proc = None
+                stderr_text = (err_b or b"").decode(errors="replace")
+                if stderr_text:
+                    sys.stderr.write(stderr_text)
+                    sys.stderr.flush()
+                if timed_out:
+                    fault_msg = (
+                        f"timeout after {point_timeout:.0f}s: "
+                        + stderr_text.strip()[-200:]
+                    )
+                elif child_rc != 0:
+                    fault_msg = (
+                        f"child exited rc={child_rc}: "
+                        + stderr_text.strip()[-400:]
+                    )
+                else:
+                    last = (out_b or b"").decode(errors="replace").strip().splitlines()
+                    try:
+                        payload = json.loads(last[-1]) if last else {}
+                        row = payload["row"]
+                        st["flops_img"] = payload.get("flops_img") or st["flops_img"]
+                    except (json.JSONDecodeError, KeyError, IndexError) as e:
+                        fault_msg = f"child rc=0 but unparsable output ({e!r})"
+                if row is not None:
+                    break
+                log(f"batch {b:4d}: FAULT (attempt {attempt}/2): {fault_msg}")
+                faults.append({"batch": b, "attempt": attempt, "fault": fault_msg})
+                if attempt == 1:
+                    # Let the TPU worker restart before retrying; a worker
+                    # crash ("kernel fault") leaves the tunnel recovering for
+                    # substantially longer than an ordinary child error.
+                    # Skip the pause when the budget cannot admit the retry
+                    # anyway -- idling 90 s inside the driver's grace window
+                    # would waste exactly the margin the budget protects.
+                    pause = 90.0 if "crashed or restarted" in (fault_msg or "") else 10.0
+                    if args.budget_s and (
+                        time.perf_counter() - t_sweep0
+                    ) + pause + 60.0 > args.budget_s:
+                        continue
+                    time.sleep(pause)
+            if row is not None:
+                results[b] = row
+            slowest_point_s = max(
+                slowest_point_s, time.perf_counter() - t_point0
             )
+            if emit is not None:
+                emit(results, faults, st["flops_img"])
+    except SweepTerminated:
+        # Ignore further SIGTERMs from here on: a second signal during this
+        # cleanup or the caller's final print would otherwise raise again
+        # and truncate the very record this path exists to save.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        st["terminated"] = True
+        log("sweep: SIGTERM received -- finalizing partial record")
+        if proc is not None:
             try:
-                out_b, err_b = proc.communicate(timeout=args.point_timeout)
-                timed_out = False
-            except subprocess.TimeoutExpired:
-                # SIGTERM first, grace, then SIGKILL: a hard kill mid-compile
-                # can wedge the single-client TPU tunnel (verify SKILL.md).
+                # Same graceful order as the timeout path: a bare SIGKILL
+                # mid-compile can wedge the single-client TPU tunnel and
+                # poison the NEXT run's points.
                 proc.terminate()
                 try:
-                    out_b, err_b = proc.communicate(timeout=30)
+                    proc.communicate(timeout=15)
                 except subprocess.TimeoutExpired:
                     proc.kill()
-                    out_b, err_b = proc.communicate()
-                timed_out = True
-            stderr_text = (err_b or b"").decode(errors="replace")
-            if stderr_text:
-                sys.stderr.write(stderr_text)
-                sys.stderr.flush()
-            if timed_out:
-                fault_msg = (
-                    f"timeout after {args.point_timeout:.0f}s: "
-                    + stderr_text.strip()[-200:]
-                )
-            elif proc.returncode != 0:
-                fault_msg = (
-                    f"child exited rc={proc.returncode}: "
-                    + stderr_text.strip()[-400:]
-                )
-            else:
-                last = (out_b or b"").decode(errors="replace").strip().splitlines()
-                try:
-                    payload = json.loads(last[-1]) if last else {}
-                    row = payload["row"]
-                    flops_img = payload.get("flops_img") or flops_img
-                except (json.JSONDecodeError, KeyError, IndexError) as e:
-                    fault_msg = f"child rc=0 but unparsable output ({e!r})"
-            if row is not None:
-                break
-            log(f"batch {b:4d}: FAULT (attempt {attempt}/2): {fault_msg}")
-            faults.append({"batch": b, "attempt": attempt, "fault": fault_msg})
-            if attempt == 1:
-                # Let the TPU worker restart before retrying; a worker
-                # crash ("kernel fault") leaves the tunnel recovering for
-                # substantially longer than an ordinary child error.
-                pause = 90.0 if "crashed or restarted" in (fault_msg or "") else 10.0
-                time.sleep(pause)
-        if row is not None:
-            results[b] = row
-    return results, faults, flops_img
+                    proc.communicate(timeout=5)
+            except Exception:  # noqa: BLE001 - dying anyway, record first
+                pass
+    return results, faults, st["flops_img"], dropped, st["terminated"]
 
 
 def bench_soak(duration_s, model, buckets):
@@ -938,16 +1171,67 @@ def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl, max_de
     return out
 
 
+def _setup_compile_cache() -> str | None:
+    """Point JAX's persistent compilation cache at a repo-local dir.
+
+    VERDICT r4 weak-1(b): every per-point bench subprocess re-paid a
+    20-55 s XLA compile because no cache was configured anywhere.  The
+    parent calls this BEFORE spawning children so they inherit the env
+    (their sitecustomize imports jax at interpreter startup -- too early
+    for anything but env); each child also calls it, which covers the
+    current process via jax.config.update.  Disable with
+    KDLT_COMPILE_CACHE_DIR=off.
+    """
+    from kubernetes_deep_learning_tpu.utils.compilecache import enable_compile_cache
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = enable_compile_cache(default_dir=os.path.join(here, ".jax_cache"))
+    if path:
+        log(f"persistent compile cache: {path}")
+    return path
+
+
+def _fake_child_row(batch: int) -> dict:
+    """Synthetic per-point row for the sweep-robustness tests ONLY
+    (KDLT_BENCH_FAKE_CHILD=1): exercises the parent's isolation, budget,
+    incremental-emission, and SIGTERM paths without touching jax or the
+    single-client TPU tunnel.  Values follow a plausible saturation curve
+    so headline selection logic is exercised too.
+    """
+    time.sleep(float(os.environ.get("KDLT_BENCH_FAKE_CHILD_SLEEP_S", "0")))
+    per_img_us = 200.0 / (1.0 + batch / 12.0) + 3.0  # saturating device
+    img_s = 1e6 / per_img_us
+    p50 = batch * per_img_us / 1e3
+    return {
+        "img_per_s": img_s,
+        "scan_img_per_s": img_s,
+        "pipelined_img_per_s": img_s * 1.02,
+        "trace_img_per_s": img_s * 1.05,
+        "method_agreement": 0.98,
+        "headline_methods": "scan/pipelined",
+        "p50_ms": p50,
+        "trace_p50_ms": p50 * 0.95,
+        "p99_ms": p50 * 1.1,
+        "p99_source": "device-trace-span",
+        "best_ms": p50 * 0.9,
+        "worst_ms": p50 * 1.2,
+        "compile_s": 0.0,
+        "mfu_pct": None,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="clothing-model",
                    help="ModelSpec name to bench (see modelspec.list_specs)")
-    # 1..128 is BASELINE.json's sweep; 48/56 bracket the p50<=15ms latency
-    # bound on v5e; 256 probes the unbound throughput ceiling.  1024 was
-    # dropped from the default in round 4: it reads within noise of 256
-    # (4616 vs 4570 img/s) and cost ~15 min of the official run's budget
-    # -- pass --batches to sweep it explicitly.
-    p.add_argument("--batches", default="1,2,4,8,16,32,48,56,64,128,256")
+    # Same point set as BASELINE.json's 1..128 sweep (+48/56 bracketing the
+    # p50<=15ms bound on v5e, 256 probing the unbound ceiling), but ordered
+    # HEADLINE-FIRST: the round-4 official run (rc=124) spent its whole
+    # budget compiling batches 1..8 in ascending order and timed out before
+    # the record-bearing batch-16 point's JSON could land.  With this order
+    # plus incremental emission, the in-bound >=4000 img/s headline is on
+    # stdout within the first ~2 points; everything after refines the sweep.
+    p.add_argument("--batches", default="16,32,8,64,48,56,4,2,1,128,256")
     p.add_argument("--scan-len", type=int, default=0,
                    help="fwd passes per timed chained-scan call (0 = auto-size "
                         "per batch to amortize dispatch RTT); the pipelined "
@@ -1003,6 +1287,16 @@ def main() -> int:
         help="per-batch-point subprocess timeout (seconds); a hung point is "
              "recorded as a fault and the sweep continues",
     )
+    p.add_argument(
+        "--budget-s", type=float,
+        default=_env_float("KDLT_BENCH_BUDGET_S", 1140.0),
+        help="overall sweep wall-clock budget (seconds, 0 = unlimited; env "
+             "KDLT_BENCH_BUDGET_S overrides the default): remaining points "
+             "are dropped -- and recorded as dropped -- when the next one "
+             "probably would not finish.  Default 19 min: the round-4 "
+             "driver killed the official run at ~25 min (rc=124), so the "
+             "sweep must self-trim well inside that",
+    )
     p.add_argument("--child-batch", type=int, default=0, help=argparse.SUPPRESS)
     p.add_argument("--flops-img", type=float, default=0.0, help=argparse.SUPPRESS)
     p.add_argument(
@@ -1026,6 +1320,15 @@ def main() -> int:
     if args.child_batch:
         # Subprocess mode for run_isolated_sweep: bench ONE batch point and
         # emit its row as the last stdout line.
+        if os.environ.get("KDLT_BENCH_FAKE_CHILD"):
+            print(json.dumps({
+                "child": True,
+                "batch": args.child_batch,
+                "row": _fake_child_row(args.child_batch),
+                "flops_img": 0.0,
+            }), flush=True)
+            return 0
+        _setup_compile_cache()
         spec, results, flops_img = bench_forward(
             args.model, [args.child_batch], args.scan_len, args.reps,
             args.dtype, args.params_dtype, args.peak_tflops,
@@ -1068,6 +1371,8 @@ def main() -> int:
         )
 
     batch_sizes = [int(b) for b in args.batches.split(",")]
+    dropped: list[int] = []
+    terminated = False
     if args.no_isolate:
         _, results, flops_img = bench_forward(
             args.model, batch_sizes, args.scan_len, args.reps, args.dtype,
@@ -1075,78 +1380,49 @@ def main() -> int:
         )
         faults = []
     else:
-        results, faults, flops_img = run_isolated_sweep(args, batch_sizes)
+        # The official-record path.  Survivability contract (VERDICT r4 #1):
+        # the last stdout line is ALWAYS a parsable headline once the first
+        # point completes -- emitted incrementally per point, re-emitted on
+        # SIGTERM, and the budget trims the tail before the driver's axe.
+        _setup_compile_cache()
+        signal.signal(signal.SIGTERM, _sigterm_handler)
 
-    if not results:
-        out = {
-            "metric": f"{args.model} images/sec/chip (EVERY batch point "
-            "faulted; no surviving measurements)",
-            "value": 0.0,
-            "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
-            "faults": faults,
-        }
-        print(json.dumps(out), flush=True)
-        return 1
+        def emit(res, fts, fpi):
+            out, _ = compose_headline(
+                args.model, args.dtype, args.params_dtype, res, fts, fpi,
+                points_total=len(batch_sizes),
+            )
+            print(json.dumps(out), flush=True)
 
-    # Headline: the north star is ">=4000 img/s/chip at p50 <= 15 ms"
-    # (BASELINE.json) -- the best MIN-of-both-methods throughput among batch
-    # sizes that MEET the latency bound AND pass the physics check
-    # (MFU <= 100% when peak is known).  Full sweep is on stderr above and
-    # in the "sweep" field below; faulted points are in "faults" (nothing
-    # hidden -- a fault zeroes one point, not the record).
-    def valid(r):
-        return r["mfu_pct"] is None or r["mfu_pct"] <= 100.0
+        # The sweep mirrors progress into ``st`` as it happens, so even a
+        # SweepTerminated that escapes the sweep's own handler (a second
+        # SIGTERM mid-cleanup) leaves the completed points printable.
+        st: dict = {}
+        try:
+            run_isolated_sweep(args, batch_sizes, emit=emit, state=st)
+        except SweepTerminated:
+            st["terminated"] = True
+        finally:
+            # The record is about to be finalized; nothing a further TERM
+            # could add but a truncated last line.
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        results = st.get("results", {})
+        faults = st.get("faults", [])
+        flops_img = st.get("flops_img", 0.0)
+        dropped = st.get("dropped", [])
+        terminated = st.get("terminated", False)
 
-    valid_pool = {b: r for b, r in results.items() if valid(r)}
-    eligible = {
-        b: r for b, r in valid_pool.items() if r["p50_ms"] <= TARGET_P50_MS
-    }
-    pool = eligible or valid_pool or results
-    headline_batch = max(pool, key=lambda b: pool[b]["img_per_s"])
-    r = results[headline_batch]
-    value = r["img_per_s"]
-    if not valid_pool:
-        bound_note = (
-            "INVALID: every batch failed the MFU<=100% physics check; "
-            "number is not trustworthy"
-        )
-    elif headline_batch in eligible:
-        bound_note = f"within p50<={TARGET_P50_MS:.0f}ms bound"
-    else:
-        bound_note = (
-            f"NO valid batch met the p50<={TARGET_P50_MS:.0f}ms bound; "
-            "best valid overall"
-        )
-    fault_note = f"; {len(faults)} faulted point attempt(s), see faults" if faults else ""
-    out = {
-        "metric": f"{args.model} images/sec/chip (best batch={headline_batch} "
-        f"{bound_note}; min of {r.get('headline_methods', 'scan/pipelined')} "
-        f"methods, agreement={r['method_agreement']:.2f}; device "
-        f"p50={r['p50_ms']:.2f}ms/batch, {args.dtype} compute, "
-        f"{args.params_dtype} params"
-        + (f", {flops_img / 1e9:.2f} GFLOPs/img" if flops_img else "")
-        + fault_note
-        + ")",
-        "value": round(value, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(value / TARGET_IMG_S, 3),
-        "mfu_pct": r["mfu_pct"],
-        "p50_ms": round(r["p50_ms"], 2),
-        "p99_ms": round(r["p99_ms"], 2) if r.get("p99_ms") is not None else None,
-        "sweep": {
-            str(b): {k: (round(v, 3) if isinstance(v, float) else v)
-                     for k, v in row.items()}
-            for b, row in sorted(results.items())
-        },
-        "faults": faults,
-    }
+    if terminated:
+        # The signal may have interrupted an in-flight emission mid-line;
+        # start fresh so the final record is guaranteed to stand alone as
+        # the last stdout line.
+        print(flush=True)
+    out, rc = compose_headline(
+        args.model, args.dtype, args.params_dtype, results, faults, flops_img,
+        dropped=dropped, terminated=terminated, points_total=len(batch_sizes),
+    )
     print(json.dumps(out), flush=True)
-    # rc=0 iff the in-bound headline exists: a valid (physics-passing) batch
-    # met the latency bound and survived.  Faults at other points (e.g. the
-    # out-of-bound 256 ceiling probe) are reported but do not nullify
-    # an in-bound record.
-    return 0 if (valid_pool and headline_batch in eligible) else 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -161,6 +161,14 @@ class ModelServer:
         # status, duration) -- the model-tier half of the gateway's
         # X-Request-Id propagation.  Errors are always logged with the rid.
         self.request_log = request_log
+        # Env-gated persistent XLA compile cache (no-op unless
+        # $KDLT_COMPILE_CACHE_DIR / $JAX_COMPILATION_CACHE_DIR is set):
+        # covers library construction; the CLI also wires --compile-cache-dir.
+        from kubernetes_deep_learning_tpu.utils.compilecache import (
+            enable_compile_cache,
+        )
+
+        enable_compile_cache()
         # profile_base: directory for /debug/profile traces; "" means a
         # default under the system temp dir, None disables the endpoint.
         if profile_base == "":
@@ -715,11 +723,26 @@ def main(argv: list[str] | None = None) -> int:
         help="leader watchdog: exit(70) for a gang restart if one lockstep "
              "round exceeds this many seconds (dead follower); 0 disables",
     )
+    p.add_argument(
+        "--compile-cache-dir",
+        default="",
+        help="persistent XLA compilation-cache directory; '' enables it only "
+        "when $KDLT_COMPILE_CACHE_DIR (or $JAX_COMPILATION_CACHE_DIR) is "
+        "set.  A pod restart then re-reads prior compiles from disk in "
+        "seconds instead of re-paying minutes of bucket warmup (the k8s "
+        "deployment mounts a cache volume for exactly this)",
+    )
     args = p.parse_args(argv)
 
     from kubernetes_deep_learning_tpu.utils.platform import force_platform
 
     force_platform(args.platform)
+
+    from kubernetes_deep_learning_tpu.utils.compilecache import enable_compile_cache
+
+    cache_path = enable_compile_cache(args.compile_cache_dir or None)
+    if cache_path:
+        print(f"persistent compile cache: {cache_path}", file=sys.stderr)
 
     from kubernetes_deep_learning_tpu.utils.distributed import initialize
 

@@ -18,12 +18,38 @@ must stay out of the distributed runtime).
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import os
 import socket
 import subprocess
 import sys
 
 import pytest
+
+
+@contextlib.contextmanager
+def _fleet_lock():
+    """Cross-PROCESS serialization of multi-process fleet tests.
+
+    VERDICT r4 weak-6: under a deliberately contended parallel run (two
+    pytest invocations sharing this box's cores) a fleet worker was
+    starved past Gloo's key-value rendezvous deadline, which is hardcoded
+    in XLA's C++ (make_gloo_tcp_collectives exposes no timeout) -- so the
+    fix must keep two fleets from ever competing for cores.  An in-process
+    pytest lock cannot see the other invocation; an OS-level flock can.
+    The jax coordination-service half of the deadline IS configurable:
+    KDLT_DIST_INIT_TIMEOUT_S (utils/distributed.py).
+    """
+    path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "kdlt-fleet-tests.lock"
+    )
+    with open(path, "a+") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
 
 _WORKER = r"""
 import os, sys
@@ -138,37 +164,47 @@ def _free_port() -> int:
 
 
 def _run_fleet_raw(worker_src: str, timeout: int = 420, extra_args=()):
-    """Run leader+follower; returns [(returncode, output), ...] unasserted."""
-    port = _free_port()
-    env_base = {
-        **os.environ,
-        "KDLT_COORDINATOR": f"127.0.0.1:{port}",
-        "KDLT_NUM_PROCESSES": "2",
-    }
-    env_base.pop("JAX_PLATFORMS", None)
-    procs = []
-    for pid, mode in ((0, "leader"), (1, "follower")):
-        env = {**env_base, "KDLT_PROCESS_ID": str(pid)}
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, "-c", worker_src, mode, *extra_args],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    """Run leader+follower; returns [(returncode, output), ...] unasserted.
+
+    The whole spawn-to-join span holds _fleet_lock so concurrent pytest
+    invocations on a shared-core box run their fleets one at a time.
+    """
+    with _fleet_lock():
+        port = _free_port()
+        env_base = {
+            **os.environ,
+            "KDLT_COORDINATOR": f"127.0.0.1:{port}",
+            "KDLT_NUM_PROCESSES": "2",
+            # Generous coordination-service join window for contended CI
+            # (honors an operator's own value when already set).
+            "KDLT_DIST_INIT_TIMEOUT_S": os.environ.get(
+                "KDLT_DIST_INIT_TIMEOUT_S", "120"
+            ),
+        }
+        env_base.pop("JAX_PLATFORMS", None)
+        procs = []
+        for pid, mode in ((0, "leader"), (1, "follower")):
+            env = {**env_base, "KDLT_PROCESS_ID": str(pid)}
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-c", worker_src, mode, *extra_args],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                )
             )
-        )
-    results = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("cross-host fleet timed out")
-        results.append((p.returncode, out))
-    return results
+        results = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("cross-host fleet timed out")
+            results.append((p.returncode, out))
+        return results
 
 
 def _run_fleet(worker_src: str, timeout: int = 420, extra_args=()):

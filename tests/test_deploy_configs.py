@@ -95,6 +95,33 @@ def test_k8s_ports_and_env_wiring():
     assert gw_svc["spec"]["ports"][0]["targetPort"] == GATEWAY_PORT
 
 
+def test_k8s_model_server_compile_cache_volume():
+    """The persistent-compile-cache wiring must be complete end to end:
+    env var -> mount -> volume (utils/compilecache.py; a restarted
+    container re-reads compiled bucket programs instead of re-paying ~10
+    min of warmup)."""
+    from kubernetes_deep_learning_tpu.utils.compilecache import ENV_VAR
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    pod = model_dep["spec"]["template"]["spec"]
+    container = pod["containers"][0]
+    env = {e["name"]: e.get("value", "") for e in container.get("env", [])}
+    assert ENV_VAR in env, "model server must point the XLA cache at a volume"
+    cache_path = env[ENV_VAR]
+    mounts = {m["name"]: m["mountPath"] for m in container.get("volumeMounts", [])}
+    assert cache_path in mounts.values(), (
+        f"{ENV_VAR}={cache_path} must be a mounted volume, not container-"
+        "ephemeral filesystem (the whole point is surviving restarts)"
+    )
+    mount_name = next(n for n, p in mounts.items() if p == cache_path)
+    assert any(v["name"] == mount_name for v in pod.get("volumes", []))
+    # ADVICE r4: steady-state readiness must evict an unhealthy pod from
+    # the endpoint pool quickly; the warmup budget lives on startupProbe.
+    assert container["readinessProbe"]["failureThreshold"] <= 5
+    assert container["startupProbe"]["failureThreshold"] >= 60
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

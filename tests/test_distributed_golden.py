@@ -23,6 +23,16 @@ def test_env_spec_complete():
     }
 
 
+def test_env_spec_init_timeout_override():
+    spec = dist.env_spec({
+        dist.COORDINATOR_ENV: "10.0.0.1:1234",
+        dist.NUM_PROCESSES_ENV: "4",
+        dist.PROCESS_ID_ENV: "2",
+        dist.INIT_TIMEOUT_ENV: "120",
+    })
+    assert spec["initialization_timeout"] == 120
+
+
 def test_env_spec_partial_is_loud():
     with pytest.raises(ValueError, match="missing"):
         dist.env_spec({dist.COORDINATOR_ENV: "10.0.0.1:1234"})
