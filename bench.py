@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import signal
 import subprocess
@@ -404,7 +405,14 @@ def bench_forward(model, batch_sizes, scan_len, reps, dtype_name, params_dtype_n
             # Target ~4 s per timed scan execution: the tunnel's ~70 ms
             # dispatch RTT amortizes to <2%, with >5x margin to the
             # observed worker execution-duration limit.
-            k = int(max(24, min(2000, 4.0 / est)))
+            # Quantize to a power of two: the chained-scan program's length
+            # is baked into its HLO, so a raw timing-derived k (which
+            # jitters ~20% run to run) would give every run a DIFFERENT
+            # scan program and defeat the persistent compile cache.  The
+            # quantization moves the timed execution by at most sqrt(2) --
+            # still >=2.8 s (RTT amortized <2%) and <<30 s (worker-safe).
+            k_raw = max(24.0, min(2000.0, 4.0 / est))
+            k = int(2 ** round(math.log2(k_raw)))
         if flops_img is None:
             # Cost analysis on the flax graph (see compiled_flops_per_image);
             # the TIMED forward may be the fused fast path.
@@ -588,22 +596,36 @@ def run_isolated_sweep(args, batch_sizes, emit=None, state=None):
                 # Clamp each attempt's child timeout to the budget REMAINING
                 # at the moment it starts (not once per point: a first
                 # attempt that hangs to its timeout must not grant the
-                # retry that same stale allowance).
+                # retry that same stale allowance).  When what remains
+                # cannot fit even a minimal attempt, do not start one at
+                # all -- flooring the timeout would overrun the budget and
+                # re-create the driver-axe failure the budget exists to
+                # prevent.
                 elapsed = time.perf_counter() - t_sweep0
                 point_timeout = args.point_timeout
                 if args.budget_s:
                     remaining = args.budget_s - elapsed
-                    if attempt > 1 and remaining < 60.0:
+                    first_ever = i == 0 and attempt == 1
+                    if first_ever:
+                        # The sweep's very first attempt always runs, with
+                        # at least 120 s: a record with ONE measured point
+                        # beats an empty record emitted punctually, and the
+                        # SIGTERM/incremental machinery still bounds the
+                        # damage if an external axe is tighter than that.
+                        point_timeout = min(point_timeout, max(remaining, 120.0))
+                    elif remaining < 90.0:
+                        what = "retry" if attempt > 1 else "attempt"
                         log(
-                            f"batch {b:4d}: retry skipped -- "
+                            f"batch {b:4d}: {what} skipped -- "
                             f"{remaining:.0f}s of budget left"
                         )
                         faults.append({
                             "batch": b, "attempt": attempt,
-                            "fault": "retry skipped: budget exhausted",
+                            "fault": f"{what} skipped: budget exhausted",
                         })
                         break
-                    point_timeout = min(point_timeout, max(120.0, remaining))
+                    else:
+                        point_timeout = min(point_timeout, remaining)
                 cmd = [
                     sys.executable, os.path.abspath(__file__),
                     "--child-batch", str(b),
@@ -655,7 +677,12 @@ def run_isolated_sweep(args, batch_sizes, emit=None, state=None):
                         payload = json.loads(last[-1]) if last else {}
                         row = payload["row"]
                         st["flops_img"] = payload.get("flops_img") or st["flops_img"]
-                    except (json.JSONDecodeError, KeyError, IndexError) as e:
+                    except (json.JSONDecodeError, KeyError, IndexError,
+                            TypeError, AttributeError) as e:
+                        # TypeError/AttributeError: the last line parsed as
+                        # a JSON scalar (stray library print) -- a fault on
+                        # this point, never a sweep-killer.
+                        row = None
                         fault_msg = f"child rc=0 but unparsable output ({e!r})"
                 if row is not None:
                     break
@@ -1010,7 +1037,8 @@ def bench_batcher_sweep(duration_s, clients, device_ms_list, max_delay_ms):
     return results
 
 
-def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl, max_delay_ms):
+def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl,
+                          max_delay_ms, stub_device_ms=0.0):
     """Can the HTTP + protocol + batcher host path carry the target WITHOUT
     the device?  (VERDICT r1: the device bench alone doesn't prove the stack
     sustains >=4000 img/s.)
@@ -1054,10 +1082,23 @@ def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl, max_de
     art.save_artifact(
         art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
     )
+    # stub_device_ms > 0 makes the stub a SERIAL async device at that
+    # latency per batch (runtime.stub async_device) -- e.g. 3.3 ms is the
+    # real chip's measured batch-16 p50, so the host path is proven against
+    # the device cadence it must actually feed (VERDICT r4 #4), rather
+    # than against an infinitely fast device.
+    if stub_device_ms > 0:
+        def make_engine(artifact, **kw):
+            return StubEngine(
+                artifact, device_ms_per_batch=stub_device_ms,
+                async_device=True, **kw,
+            )
+    else:
+        make_engine = StubEngine
     server = ModelServer(
         root, port=0, buckets=(1, 2, 4, 8, 16, 32, 64, 128),
         max_delay_ms=max_delay_ms, batcher_impl=batcher_impl,
-        host="127.0.0.1", engine_factory=StubEngine,
+        host="127.0.0.1", engine_factory=make_engine,
     )
     server.warmup()
     model = server.models[spec.name]
@@ -1254,6 +1295,12 @@ def main() -> int:
         "path with a stub engine for this many seconds per batch size",
     )
     p.add_argument(
+        "--stub-device-ms", type=float, default=0.0,
+        help="host-saturation only: simulate a SERIAL async device at this "
+             "many ms per batch (0 = instantaneous stub); 3.3 is the real "
+             "chip's measured batch-16 p50",
+    )
+    p.add_argument(
         "--request-batches", default="1,4,16,64,256",
         help="host-saturation request batch sizes",
     )
@@ -1358,6 +1405,7 @@ def main() -> int:
             [int(b) for b in args.request_batches.split(",")],
             args.batcher,
             args.max_delay_ms,
+            stub_device_ms=args.stub_device_ms,
         )
         return 0
 

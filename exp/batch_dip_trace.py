@@ -117,11 +117,18 @@ def main() -> None:
         d = details.get(n, "")
         print(row + ("   " + d[:90] if d else ""))
 
-    # Bucket into families for the summary.
+    # Bucket into families for the summary.  NB: "conv" must not be a bare
+    # prefix test -- XLA names elementwise-cast fusions "CONVert_*_fusion",
+    # which a "conv" prefix match silently books under convolution (this
+    # inflated the B3 convolution row by ~5x before round 5; the SE-pool
+    # convert_reduce_fusions are reduce/fusion family, not convs).
     fam_of = lambda n: (  # noqa: E731
         "pallas-fused" if "custom-call" in n or "tpu_custom_call" in n
+        else "reduce-fusion" if n.startswith(("convert_reduce_fusion", "reduce"))
         else "convolution" if n.startswith(("convolution", "conv"))
+        and not n.startswith("convert")
         else "fusion" if n.startswith(("fusion", "loop_fusion", "input_fusion"))
+        or n.startswith(("convert", "add_convert"))
         else "copy/transpose" if re.match(r"(copy|transpose|bitcast)", n)
         else "other"
     )

@@ -79,9 +79,20 @@ def main() -> int:
         child(args.cache_dir, args.model, buckets)
         return 0
 
-    cache_dir = args.cache_dir or tempfile.mkdtemp(prefix="kdlt-cache-exp-")
+    # Only a temp dir WE created is ever wiped: an operator-supplied
+    # --cache-dir may be a live production cache (e.g. .jax_cache), and the
+    # cold/restart split simply reads differently on a pre-populated dir
+    # (the "cold" row is then already partially warm -- noted in stderr).
     cleanup = not args.cache_dir
-    shutil.rmtree(cache_dir, ignore_errors=True)
+    cache_dir = args.cache_dir or tempfile.mkdtemp(prefix="kdlt-cache-exp-")
+    if cleanup:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    elif os.path.isdir(cache_dir) and os.listdir(cache_dir):
+        print(
+            f"note: {cache_dir} is non-empty; the 'cold' row will read "
+            "partially warm (pass no --cache-dir for a true cold run)",
+            file=sys.stderr,
+        )
     os.makedirs(cache_dir, exist_ok=True)
     runs = {}
     try:

@@ -138,8 +138,8 @@ class ServableNotFound(Exception):
     """Maps to NOT_FOUND, message already in TF-Serving's wording."""
 
 
-def _check_version_pin(request, model) -> None:
-    """Reject a request pinned to anything but the loaded version.
+def _check_version_pin(ms, model) -> None:
+    """Reject a ModelSpec pinned to anything but the loaded version.
 
     Covers BOTH arms of model_spec's version_choice oneof: a numeric
     ``version`` other than the loaded one, and ANY ``version_label`` --
@@ -147,7 +147,6 @@ def _check_version_pin(request, model) -> None:
     TF-Serving fails an unknown label too; silently serving the live
     version would be the exact mis-attribution ADVICE r3 flagged).
     """
-    ms = request.model_spec
     name = ms.name
     try:
         if ms.HasField("version_label") and ms.version_label:
@@ -181,6 +180,23 @@ class PredictionServicer:
         )
 
     def Predict(self, request: predict_pb2.PredictRequest, context):
+        return self._serve_unary(request, context, self._predict, "predict")
+
+    def Classify(self, request, context):
+        return self._serve_unary(request, context, self._classify, "classify")
+
+    def Regress(self, request, context):
+        return self._serve_unary(request, context, self._regress, "regress")
+
+    def MultiInference(self, request, context):
+        return self._serve_unary(
+            request, context, self._multi_inference, "multi-inference"
+        )
+
+    def _serve_unary(self, request, context, impl, kind: str):
+        """Shared RPC shell: request-id propagation, metrics, and the
+        TF-Serving status-code ladder, identical across the four unary
+        PredictionService methods."""
         from kubernetes_deep_learning_tpu.serving.tracing import (
             GRPC_METADATA_KEY,
             ensure_request_id,
@@ -194,7 +210,7 @@ class PredictionServicer:
         status = "INTERNAL"
         self._m_requests.inc()
         try:
-            resp = self._predict(request)
+            resp = impl(request)
             status = "OK"
             return resp
         except KeyError as e:
@@ -228,11 +244,11 @@ class PredictionServicer:
             self._m_latency.observe(time.perf_counter() - t0)
             if self._server.request_log or status == "INTERNAL":
                 log_request(
-                    "model-server grpc-predict",
+                    f"model-server grpc-{kind}",
                     rid,
                     status=status,
                     t0=t0,
-                    model=request.model_spec.name,
+                    model=_request_model_name(request),
                 )
 
     def GetModelMetadata(self, request, context):
@@ -261,7 +277,7 @@ class PredictionServicer:
         # silently attributed to a different one (ADVICE r3): only the
         # loaded version is resolvable here (one live version per model).
         try:
-            _check_version_pin(request, model)
+            _check_version_pin(request.model_spec, model)
         except ServableNotFound as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         fields = list(request.metadata_field) or ["signature_def"]
@@ -303,18 +319,8 @@ class PredictionServicer:
             MAX_IMAGES_PER_REQUEST,
         )
 
-        name = request.model_spec.name
-        model = self._server.models.get(name)
-        if model is None:
-            raise KeyError(name)
-        # Same version pinning contract as GetModelMetadata: a request for
-        # a version (or label) other than the loaded one is NOT_FOUND, not
-        # silently served from whatever is live.
-        _check_version_pin(request, model)
+        model = self._resolve_model(request.model_spec)
         spec = model.artifact.spec
-        sig = request.model_spec.signature_name
-        if sig not in ("", "serving_default"):
-            raise ValueError(f"unknown signature {sig!r} (only serving_default)")
 
         inputs = dict(request.inputs)
         tp = inputs.get(spec.input_name) or (
@@ -371,6 +377,242 @@ class PredictionServicer:
             resp.outputs[spec.compat_output_name].CopyFrom(out)
         return resp
 
+    # --- Classify / Regress / MultiInference ------------------------------
+    # The reference model tier is the full tensorflow/serving:2.3.0 binary
+    # (reference tf-serving.dockerfile:2), whose PredictionService carries
+    # these three RPCs alongside Predict; its own client uses only Predict
+    # (reference model_server.py:55), so this is wire-surface parity for
+    # third-party TF-Serving clients.  Input is the Example-list envelope
+    # (tfs_protos/.../input.proto); scores are the served contract's raw
+    # logits, same values the Predict/HTTP tiers return for the same image.
+
+    def _resolve_model(self, model_spec):
+        """Shared servable resolution: name + version pin + signature."""
+        name = model_spec.name
+        model = self._server.models.get(name)
+        if model is None:
+            raise KeyError(name)
+        _check_version_pin(model_spec, model)
+        sig = model_spec.signature_name
+        if sig not in ("", "serving_default"):
+            raise ValueError(f"unknown signature {sig!r} (only serving_default)")
+        return model
+
+    def _classification_result(self, spec, logits):
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+            classification_pb2,
+        )
+
+        result = classification_pb2.ClassificationResult()
+        for row in logits:
+            cl = result.classifications.add()
+            for j in np.argsort(-row):  # all classes, descending score
+                c = cl.classes.add()
+                c.label = spec.labels[int(j)]
+                c.score = float(row[int(j)])
+        return result
+
+    def _regression_result(self, spec, logits):
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+            regression_pb2,
+        )
+
+        if spec.num_classes != 1:
+            # TF-Serving rejects regress on a servable without a regress
+            # signature; every spec here is a classifier unless 1-output.
+            raise ValueError(
+                f"Expected a regression signature: {spec.name!r} has "
+                f"{spec.num_classes} outputs (method_name "
+                "tensorflow/serving/regress needs exactly 1)"
+            )
+        result = regression_pb2.RegressionResult()
+        for v in logits[:, 0]:
+            result.regressions.add().value = float(v)
+        return result
+
+    def _classify(self, request):
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+            classification_pb2,
+        )
+
+        model = self._resolve_model(request.model_spec)
+        images = images_from_input(request.input, model.artifact.spec)
+        resp = classification_pb2.ClassificationResponse()
+        resp.model_spec.name = model.artifact.spec.name
+        resp.model_spec.signature_name = "serving_default"
+        resp.model_spec.version.value = model.version
+        logits = np.asarray(model.predict(images), dtype=np.float32)
+        resp.result.CopyFrom(
+            self._classification_result(model.artifact.spec, logits)
+        )
+        return resp
+
+    def _regress(self, request):
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+            regression_pb2,
+        )
+
+        model = self._resolve_model(request.model_spec)
+        images = images_from_input(request.input, model.artifact.spec)
+        resp = regression_pb2.RegressionResponse()
+        resp.model_spec.name = model.artifact.spec.name
+        resp.model_spec.signature_name = "serving_default"
+        resp.model_spec.version.value = model.version
+        logits = np.asarray(model.predict(images), dtype=np.float32)
+        resp.result.CopyFrom(self._regression_result(model.artifact.spec, logits))
+        return resp
+
+    def _multi_inference(self, request):
+        from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+            inference_pb2,
+        )
+
+        if not request.tasks:
+            raise ValueError("MultiInferenceRequest must carry at least one task")
+        names = {t.model_spec.name for t in request.tasks}
+        if len(names) != 1:
+            # Same constraint as TF-Serving: one servable per request.
+            raise ValueError(
+                f"all MultiInference tasks must target one servable, got "
+                f"{sorted(names)}"
+            )
+        resp = inference_pb2.MultiInferenceResponse()
+        logits = None
+        for task in request.tasks:
+            model = self._resolve_model(task.model_spec)
+            if logits is None:
+                # One servable, one input: decode and run the device ONCE;
+                # every task reads the same logits.
+                images = images_from_input(request.input, model.artifact.spec)
+                logits = np.asarray(model.predict(images), dtype=np.float32)
+            r = resp.results.add()
+            r.model_spec.name = model.artifact.spec.name
+            r.model_spec.signature_name = "serving_default"
+            r.model_spec.version.value = model.version
+            if task.method_name == "tensorflow/serving/classify":
+                r.classification_result.CopyFrom(
+                    self._classification_result(model.artifact.spec, logits)
+                )
+            elif task.method_name == "tensorflow/serving/regress":
+                r.regression_result.CopyFrom(
+                    self._regression_result(model.artifact.spec, logits)
+                )
+            else:
+                raise ValueError(
+                    f"unsupported task method_name {task.method_name!r} "
+                    "(tensorflow/serving/classify or tensorflow/serving/regress)"
+                )
+        return resp
+
+
+def _request_model_name(request) -> str:
+    """Model name for the request log line; MultiInferenceRequest carries
+    its specs per task rather than top-level."""
+    spec = getattr(request, "model_spec", None)
+    if spec is not None:
+        return spec.name
+    tasks = getattr(request, "tasks", None)
+    return tasks[0].model_spec.name if tasks else ""
+
+
+def _example_to_image(ex, spec) -> np.ndarray:
+    """One tensorflow.Example -> one image row of spec.input_shape.
+
+    Accepted feature keys, in order: the spec's input_name /
+    compat_input_name, TF's conventional image/encoded and image_bytes,
+    x, or -- when the example has exactly one feature -- anything.
+    bytes_list values are JPEG/PNG, decoded + resized through the same
+    host pipeline as the gateway (ops.preprocess, spec.resize_filter);
+    float_list is a pre-normalized flat image; int64_list is flat uint8
+    pixels.
+    """
+    feats = ex.features.feature
+    preferred = [
+        spec.input_name, spec.compat_input_name, "image/encoded",
+        "image_bytes", "x",
+    ]
+    key = next((k for k in preferred if k and k in feats), None)
+    if key is None:
+        if len(feats) == 1:
+            key = next(iter(feats))
+        else:
+            raise ValueError(
+                f"example features {sorted(feats)} do not include one of "
+                f"{[k for k in preferred if k]}"
+            )
+    f = feats[key]
+    kind = f.WhichOneof("kind")
+    n_px = int(np.prod(spec.input_shape))
+    if kind == "bytes_list":
+        from kubernetes_deep_learning_tpu.ops.preprocess import preprocess_bytes
+
+        if len(f.bytes_list.value) != 1:
+            raise ValueError("expected exactly one encoded image per example")
+        if spec.input_shape[2] != 3:
+            raise ValueError(
+                f"encoded-image input needs a 3-channel spec, have "
+                f"{spec.input_shape}"
+            )
+        return preprocess_bytes(
+            f.bytes_list.value[0], spec.input_shape[:2],
+            filter=spec.resize_filter,
+        )
+    if kind == "float_list":
+        arr = np.asarray(f.float_list.value, dtype=np.float32)
+        if arr.size != n_px:
+            raise ValueError(
+                f"float feature {key!r} has {arr.size} values, expected "
+                f"{n_px} for shape {spec.input_shape}"
+            )
+        return arr.reshape(spec.input_shape)
+    if kind == "int64_list":
+        arr = np.asarray(f.int64_list.value, dtype=np.int64)
+        if arr.size != n_px:
+            raise ValueError(
+                f"int64 feature {key!r} has {arr.size} values, expected "
+                f"{n_px} for shape {spec.input_shape}"
+            )
+        if arr.size and (arr.min() < 0 or arr.max() > 255):
+            raise ValueError(
+                "integer pixel values must be in [0, 255]; send floats for "
+                "pre-normalized data"
+            )
+        return arr.astype(np.uint8).reshape(spec.input_shape)
+    raise ValueError(f"example feature {key!r} is empty")
+
+
+def images_from_input(inp, spec) -> np.ndarray:
+    """TF-Serving Input envelope -> (N, H, W, C) batch for the engine.
+
+    uint8 rows (encoded images / int64 pixels) are normalized on device
+    like every other wire path; float rows pass through pre-normalized.
+    Mixing the two in one request is rejected rather than silently
+    upcasting pixels past normalization.
+    """
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        MAX_IMAGES_PER_REQUEST,
+    )
+
+    kind = inp.WhichOneof("kind")
+    if kind == "example_list_with_context":
+        raise ValueError("example_list_with_context input is not supported")
+    if kind != "example_list" or not inp.example_list.examples:
+        raise ValueError("Input must carry a non-empty example_list")
+    examples = inp.example_list.examples
+    if len(examples) > MAX_IMAGES_PER_REQUEST:
+        raise ValueError(
+            f"batch {len(examples)} exceeds the {MAX_IMAGES_PER_REQUEST}-"
+            "image request limit"
+        )
+    rows = [_example_to_image(ex, spec) for ex in examples]
+    dtypes = {r.dtype for r in rows}
+    if len(dtypes) != 1:
+        raise ValueError(
+            "examples mix uint8 pixel and float32 pre-normalized features; "
+            "send one kind per request"
+        )
+    return np.stack(rows)
+
 
 def add_to_server(servicer: PredictionServicer, grpc_server: grpc.Server) -> None:
     """Register the servicer under the TF-Serving method path.
@@ -381,7 +623,10 @@ def add_to_server(servicer: PredictionServicer, grpc_server: grpc.Server) -> Non
     Predict.
     """
     from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+        classification_pb2,
         get_model_metadata_pb2,
+        inference_pb2,
+        regression_pb2,
     )
 
     handlers = {
@@ -389,6 +634,21 @@ def add_to_server(servicer: PredictionServicer, grpc_server: grpc.Server) -> Non
             servicer.Predict,
             request_deserializer=predict_pb2.PredictRequest.FromString,
             response_serializer=predict_pb2.PredictResponse.SerializeToString,
+        ),
+        "Classify": grpc.unary_unary_rpc_method_handler(
+            servicer.Classify,
+            request_deserializer=classification_pb2.ClassificationRequest.FromString,
+            response_serializer=classification_pb2.ClassificationResponse.SerializeToString,
+        ),
+        "Regress": grpc.unary_unary_rpc_method_handler(
+            servicer.Regress,
+            request_deserializer=regression_pb2.RegressionRequest.FromString,
+            response_serializer=regression_pb2.RegressionResponse.SerializeToString,
+        ),
+        "MultiInference": grpc.unary_unary_rpc_method_handler(
+            servicer.MultiInference,
+            request_deserializer=inference_pb2.MultiInferenceRequest.FromString,
+            response_serializer=inference_pb2.MultiInferenceResponse.SerializeToString,
         ),
         "GetModelMetadata": grpc.unary_unary_rpc_method_handler(
             servicer.GetModelMetadata,

@@ -71,17 +71,24 @@ def enable_compile_cache(cache_dir: str | None = None, *,
         import jax
 
         jax.config.update("jax_compilation_cache_dir", path)
-        # Default thresholds skip "cheap" compiles; our cold-start problem
-        # IS many ~1-60 s compiles, so cache everything non-trivial.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        try:
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        except Exception:  # noqa: BLE001 - knob absent on older jax
-            pass
-        # Export for any child interpreters (their sitecustomize imports
-        # jax before library code runs, so only env reaches them in time).
-        os.environ[ENV_VAR] = path
-        os.environ[JAX_ENV_VAR] = path
-        return path
     except Exception:  # noqa: BLE001 - cache is best-effort by contract
         return None
+    # The cache is now ON; the threshold knobs below are tuning only and
+    # must not flip the return to None on a jax that lacks them -- a
+    # half-enabled-but-reported-disabled cache would desynchronize every
+    # caller (and the env export below) from the actual process state.
+    for knob, value in (
+        # Default thresholds skip "cheap" compiles; our cold-start problem
+        # IS many ~1-60 s compiles, so cache everything non-trivial.
+        ("jax_persistent_cache_min_compile_time_secs", 0.5),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # noqa: BLE001 - knob absent on older jax
+            pass
+    # Export for any child interpreters (their sitecustomize imports
+    # jax before library code runs, so only env reaches them in time).
+    os.environ[ENV_VAR] = path
+    os.environ[JAX_ENV_VAR] = path
+    return path

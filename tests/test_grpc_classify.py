@@ -1,0 +1,234 @@
+"""Classify / Regress / MultiInference wire tests (TF-Serving surface).
+
+The reference model tier is the full tensorflow/serving:2.3.0 binary
+(reference tf-serving.dockerfile:2), whose PredictionService exposes these
+RPCs alongside Predict; the reference's own client uses only Predict
+(reference model_server.py:55), so these exist for third-party TF-Serving
+clients.  Each test marshals the Example-list Input envelope exactly as
+tf.make_example-style clients would (hand-written wire-compatible protos in
+serving/tfs_protos) and reads the response through the public field numbers.
+"""
+
+from __future__ import annotations
+
+import io
+
+import grpc
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.serving.grpc_predict import (
+    SERVICE_NAME,
+    serve_grpc,
+)
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow_serving.apis import (
+    classification_pb2,
+    inference_pb2,
+    predict_pb2,
+    regression_pb2,
+)
+
+
+@pytest.fixture(scope="module")
+def classify_stack(tmp_path_factory):
+    spec = register_spec(
+        ModelSpec(
+            name="classify-xception",
+            family="xception",
+            input_shape=(64, 64, 3),
+            labels=("dress", "hat", "pants"),
+            preprocessing="tf",
+        )
+    )
+    root = tmp_path_factory.mktemp("models")
+    export_model(spec, init_variables(spec, seed=3), str(root), dtype=np.float32)
+    server = ModelServer(str(root), port=0, buckets=(1, 2, 4), max_delay_ms=1.0)
+    server.warmup()
+    grpc_server, port = serve_grpc(server, 0, host="127.0.0.1")
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    def method(name, req_cls, resp_cls):
+        return channel.unary_unary(
+            f"/{SERVICE_NAME}/{name}",
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+
+    calls = {
+        "classify": method(
+            "Classify",
+            classification_pb2.ClassificationRequest,
+            classification_pb2.ClassificationResponse,
+        ),
+        "regress": method(
+            "Regress",
+            regression_pb2.RegressionRequest,
+            regression_pb2.RegressionResponse,
+        ),
+        "multi": method(
+            "MultiInference",
+            inference_pb2.MultiInferenceRequest,
+            inference_pb2.MultiInferenceResponse,
+        ),
+        "predict": method(
+            "Predict", predict_pb2.PredictRequest, predict_pb2.PredictResponse
+        ),
+    }
+    yield spec, server, calls
+    channel.close()
+    grpc_server.stop(grace=None)
+    server.shutdown()
+
+
+def _pixel_request(spec, images):
+    """uint8 (N,H,W,C) -> ClassificationRequest with int64 pixel features."""
+    req = classification_pb2.ClassificationRequest()
+    req.model_spec.name = spec.name
+    for img in images:
+        ex = req.input.example_list.examples.add()
+        ex.features.feature[spec.input_name].int64_list.value.extend(
+            int(v) for v in img.reshape(-1)
+        )
+    return req
+
+
+def _predict_logits(spec, calls, images):
+    from kubernetes_deep_learning_tpu.serving.grpc_predict import (
+        tensor_proto_from_array,
+    )
+
+    req = predict_pb2.PredictRequest()
+    req.model_spec.name = spec.name
+    req.inputs[spec.input_name].CopyFrom(tensor_proto_from_array(images))
+    resp = calls["predict"](req, timeout=60)
+    out = np.array(resp.outputs[spec.output_name].float_val, np.float32)
+    return out.reshape(images.shape[0], spec.num_classes)
+
+
+def test_classify_matches_predict_logits(classify_stack):
+    spec, _, calls = classify_stack
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (2, *spec.input_shape), np.uint8)
+    resp = calls["classify"](_pixel_request(spec, images), timeout=60)
+    assert resp.model_spec.name == spec.name
+    assert resp.model_spec.version.value == 1
+    assert len(resp.result.classifications) == 2
+    logits = _predict_logits(spec, calls, images)
+    for row, cl in zip(logits, resp.result.classifications):
+        # All classes present, descending by score, scores == Predict logits.
+        assert [c.label for c in cl.classes] == [
+            spec.labels[j] for j in np.argsort(-row)
+        ]
+        got = {c.label: c.score for c in cl.classes}
+        want = dict(zip(spec.labels, row))
+        for label in spec.labels:
+            assert got[label] == pytest.approx(want[label], rel=1e-5)
+
+
+def test_classify_accepts_encoded_and_float_features(classify_stack):
+    spec, _, calls = classify_stack
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, spec.input_shape, np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+
+    req = classification_pb2.ClassificationRequest()
+    req.model_spec.name = spec.name
+    ex = req.input.example_list.examples.add()
+    ex.features.feature["image/encoded"].bytes_list.value.append(buf.getvalue())
+    resp = calls["classify"](req, timeout=60)
+    # PNG is lossless and already at spec size: scores must match the same
+    # pixels sent as a Predict tensor.
+    logits = _predict_logits(spec, calls, img[None])
+    got = {c.label: c.score for c in resp.result.classifications[0].classes}
+    for j, label in enumerate(spec.labels):
+        assert got[label] == pytest.approx(logits[0, j], rel=1e-5)
+
+    # Float features ride the pre-normalized path end to end.
+    req2 = classification_pb2.ClassificationRequest()
+    req2.model_spec.name = spec.name
+    ex2 = req2.input.example_list.examples.add()
+    ex2.features.feature["x"].float_list.value.extend(
+        np.zeros(int(np.prod(spec.input_shape)), np.float32)
+    )
+    resp2 = calls["classify"](req2, timeout=60)
+    assert len(resp2.result.classifications[0].classes) == spec.num_classes
+
+
+def test_classify_error_statuses(classify_stack):
+    spec, _, calls = classify_stack
+    # Unknown servable -> NOT_FOUND with TF-Serving's wording.
+    req = classification_pb2.ClassificationRequest()
+    req.model_spec.name = "no-such-model"
+    req.input.example_list.examples.add().features.feature["x"].float_list.value.append(0.0)
+    with pytest.raises(grpc.RpcError) as err:
+        calls["classify"](req, timeout=30)
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
+    assert "Servable not found" in err.value.details()
+
+    # Empty input -> INVALID_ARGUMENT.
+    req2 = classification_pb2.ClassificationRequest()
+    req2.model_spec.name = spec.name
+    with pytest.raises(grpc.RpcError) as err2:
+        calls["classify"](req2, timeout=30)
+    assert err2.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    # Wrong-size float feature -> INVALID_ARGUMENT naming the expectation.
+    req3 = classification_pb2.ClassificationRequest()
+    req3.model_spec.name = spec.name
+    ex = req3.input.example_list.examples.add()
+    ex.features.feature["x"].float_list.value.extend([1.0, 2.0])
+    with pytest.raises(grpc.RpcError) as err3:
+        calls["classify"](req3, timeout=30)
+    assert err3.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "expected" in err3.value.details()
+
+
+def test_regress_rejected_on_classifier(classify_stack):
+    spec, _, calls = classify_stack
+    req = regression_pb2.RegressionRequest()
+    req.model_spec.name = spec.name
+    ex = req.input.example_list.examples.add()
+    ex.features.feature["x"].float_list.value.extend(
+        np.zeros(int(np.prod(spec.input_shape)), np.float32)
+    )
+    with pytest.raises(grpc.RpcError) as err:
+        calls["regress"](req, timeout=60)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "regression signature" in err.value.details()
+
+
+def test_multi_inference_classify_task(classify_stack):
+    spec, _, calls = classify_stack
+    rng = np.random.default_rng(2)
+    images = rng.integers(0, 256, (1, *spec.input_shape), np.uint8)
+    req = inference_pb2.MultiInferenceRequest()
+    task = req.tasks.add()
+    task.model_spec.name = spec.name
+    task.method_name = "tensorflow/serving/classify"
+    for img in images:
+        ex = req.input.example_list.examples.add()
+        ex.features.feature[spec.input_name].int64_list.value.extend(
+            int(v) for v in img.reshape(-1)
+        )
+    resp = calls["multi"](req, timeout=60)
+    assert len(resp.results) == 1
+    r = resp.results[0]
+    assert r.WhichOneof("result") == "classification_result"
+    assert len(r.classification_result.classifications) == 1
+    logits = _predict_logits(spec, calls, images)
+    got = {c.label: c.score for c in r.classification_result.classifications[0].classes}
+    for j, label in enumerate(spec.labels):
+        assert got[label] == pytest.approx(logits[0, j], rel=1e-5)
+
+    # Unsupported method name -> INVALID_ARGUMENT.
+    req.tasks[0].method_name = "tensorflow/serving/rank"
+    with pytest.raises(grpc.RpcError) as err:
+        calls["multi"](req, timeout=30)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
