@@ -1,21 +1,31 @@
-from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
+from kubernetes_deep_learning_tpu.runtime.engine import (
+    DispatcherClosed,
+    InferenceEngine,
+    InFlightDispatcher,
+    resolve_pipeline_depth,
+)
 from kubernetes_deep_learning_tpu.runtime.batcher import BatcherClosed, DynamicBatcher, QueueFull
 
 
-def create_batcher(engine, impl: str = "auto", **kwargs):
+def create_batcher(engine, impl: str = "auto", dispatcher=None, **kwargs):
     """Pick the batching implementation.
 
     "native" -> the C++ queue (native/batchqueue.cc); "python" -> the
     pure-Python DynamicBatcher; "auto" -> native when the compiled library
     is available AND the host has a core to overlap with, else Python.
-    Both have identical policy and surface.
+    Both have identical policy and surface, including the multi-in-flight
+    dispatch pipeline (``pipeline_depth`` kwarg / $KDLT_PIPELINE_DEPTH).
+    ``dispatcher`` injects a shared InFlightDispatcher into the Python
+    batcher (the native queue pipelines in its own dispatch loop instead,
+    so the kwarg is dropped for it).
 
     The core check is measured, not theoretical (bench.py --batcher-sweep,
-    BENCH.md round 3): the native batcher's depth-2 pipeline spreads
-    dispatch across threads (dispatcher, device sync, C++ completion), and
-    on a single-core host the GIL convoys those handoffs -- the Python
-    batcher's one-thread dispatch loop beats it at every simulated device
-    latency (0.5-10 ms).  The pipeline needs a second core to pay off.
+    BENCH.md round 3): the native batcher's multi-in-flight pipeline
+    spreads dispatch across threads (dispatcher, device sync, C++
+    completion), and on a single-core host the GIL convoys those handoffs
+    -- the Python batcher's one-thread dispatch loop beats it at every
+    simulated device latency (0.5-10 ms).  The pipeline needs a second
+    core to pay off.
     """
     import os
 
@@ -39,13 +49,16 @@ def create_batcher(engine, impl: str = "auto", **kwargs):
         except ImportError:
             if impl == "native":
                 raise
-    return DynamicBatcher(engine, **kwargs)
+    return DynamicBatcher(engine, dispatcher=dispatcher, **kwargs)
 
 
 __all__ = [
     "BatcherClosed",
+    "DispatcherClosed",
     "DynamicBatcher",
     "InferenceEngine",
+    "InFlightDispatcher",
     "QueueFull",
     "create_batcher",
+    "resolve_pipeline_depth",
 ]

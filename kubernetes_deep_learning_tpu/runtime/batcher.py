@@ -11,6 +11,16 @@ when the batch is small, waits up to ``max_delay`` for more work to arrive.
 Under light load a request therefore pays at most max_delay extra latency;
 under heavy load the engine is never idle and batches grow to ``max_batch``
 naturally, with no timer on the hot path.
+
+Pipelined dispatch: against an engine exposing ``predict_async`` the
+dispatch thread hands each assembled batch to an InFlightDispatcher
+(runtime.engine) and immediately loops back to assemble the NEXT batch --
+batch N+1's gather/stack/H2D overlaps batch N's device execution, and the
+dispatcher's completion thread fans results out to the request futures.
+Backpressure comes from the dispatcher's bounded in-flight depth: submit
+blocks once ``pipeline_depth`` batches are in flight, so the queue (not
+unbounded device work) absorbs overload.  Plain engines (no
+``predict_async``) get the original dispatch-then-sync loop.
 """
 
 from __future__ import annotations
@@ -21,6 +31,10 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from kubernetes_deep_learning_tpu.runtime.engine import (
+    InFlightDispatcher,
+    resolve_pipeline_depth,
+)
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 
@@ -40,7 +54,15 @@ class DynamicBatcher:
         max_delay_ms: float = 2.0,
         queue_cap: int = 2048,
         registry: metrics_lib.Registry | None = None,
+        pipeline_depth: int | None = None,
+        dispatcher: InFlightDispatcher | None = None,
     ):
+        """``pipeline_depth`` bounds how many batches may be in flight on the
+        device at once (None = $KDLT_PIPELINE_DEPTH or 2; 1 = serial
+        dispatch).  ``dispatcher`` injects a shared InFlightDispatcher --
+        e.g. the ServedModel's, so the batcher and the direct multi-image
+        path share one in-flight budget; the batcher then does NOT close it.
+        """
         self._engine = engine
         self.max_batch = max_batch or engine.max_batch
         self.max_delay = max_delay_ms / 1000.0
@@ -50,6 +72,15 @@ class DynamicBatcher:
         self._closed = False
 
         registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
+        self._dispatcher = dispatcher
+        self._owns_dispatcher = False
+        if dispatcher is None:
+            depth = resolve_pipeline_depth(pipeline_depth)
+            if depth > 1 and hasattr(engine, "predict_async"):
+                self._dispatcher = InFlightDispatcher(
+                    engine, depth=depth, registry=registry
+                )
+                self._owns_dispatcher = True
         self._m_batch_size = registry.histogram(
             "kdlt_batcher_batch_size",
             "dispatched batch sizes",
@@ -115,6 +146,24 @@ class DynamicBatcher:
             if not batch:
                 return  # closed and drained
             self._m_batch_size.observe(len(batch))
+            if self._dispatcher is not None:
+                # Pipelined path: enqueue and IMMEDIATELY go assemble the
+                # next batch -- its gather/stack overlaps this batch's
+                # device execution.  submit() itself provides backpressure
+                # (blocks at the in-flight depth limit); the dispatcher's
+                # completion thread runs _publish via the done callback.
+                try:
+                    images = np.stack([img for img, _ in batch])
+                    fut_batch = self._dispatcher.submit(images)
+                except Exception as e:  # closed dispatcher / bad batch
+                    for _, fut in batch:
+                        if not fut.cancelled():
+                            fut.set_exception(e)
+                    continue
+                fut_batch.add_done_callback(
+                    lambda f, batch=batch: self._publish(batch, f)
+                )
+                continue
             try:
                 images = np.stack([img for img, _ in batch])
                 logits = self._engine.predict(images)
@@ -127,6 +176,22 @@ class DynamicBatcher:
                 if not fut.cancelled():
                     fut.set_result(logits[i])
 
+    @staticmethod
+    def _publish(batch, fut_batch: Future) -> None:
+        """Fan one completed batch's rows (or its failure) out to its
+        waiters.  Runs on the dispatcher's completion thread; must not
+        raise (it would kill result delivery for later batches)."""
+        exc = fut_batch.exception()
+        if exc is not None:
+            for _, fut in batch:
+                if not fut.cancelled():
+                    fut.set_exception(exc)
+            return
+        logits = fut_batch.result()
+        for i, (_, fut) in enumerate(batch):
+            if not fut.cancelled():
+                fut.set_result(logits[i])
+
     def close(self, drain: bool = True) -> None:
         with self._cond:
             self._closed = True
@@ -137,3 +202,9 @@ class DynamicBatcher:
                     fut.set_exception(BatcherClosed("batcher shut down"))
             self._cond.notify_all()
         self._thread.join(timeout=30.0)
+        # After the dispatch thread has exited nothing else submits, so a
+        # dispatcher close cannot race; it drains the in-flight batches and
+        # resolves their futures.  Shared (injected) dispatchers belong to
+        # their creator.
+        if self._owns_dispatcher:
+            self._dispatcher.close(drain=True)

@@ -14,16 +14,22 @@ TPU-first design decisions:
   recompiles.  This is SURVEY.md section 7's hard part (b).
 - **Normalization on device.** The engine takes uint8 batches straight off
   the wire; the scale/shift fuses into the first conv (see models.build_forward).
-- **Single dispatch thread semantics.** predict() is thread-safe; dispatch
-  is serialized by a lock since one accelerator executes one program at a
-  time anyway (the dynamic batcher is what creates large batches, not
-  concurrent dispatch).
+- **Pipelined dispatch, serialized enqueue.** predict() is thread-safe;
+  only the ENQUEUE of a program is serialized by a lock (one accelerator
+  executes one program at a time anyway, and JAX's async dispatch returns
+  as soon as the execution is queued).  The host work around a batch --
+  gather/pad, H2D transfer, result readback -- is what must NOT serialize
+  against device execution: InFlightDispatcher below keeps a bounded
+  number of batches in flight so batch N+1's host side overlaps batch N's
+  device time, with readback on a dedicated completion thread.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+from concurrent.futures import Future
 from typing import Any, Sequence
 
 import numpy as np
@@ -32,6 +38,175 @@ from kubernetes_deep_learning_tpu.export.artifact import ModelArtifact
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+PIPELINE_DEPTH_ENV = "KDLT_PIPELINE_DEPTH"
+DEFAULT_PIPELINE_DEPTH = 2
+
+
+def resolve_pipeline_depth(depth: int | None = None) -> int:
+    """The in-flight dispatch depth: explicit arg > $KDLT_PIPELINE_DEPTH > 2.
+
+    Depth 1 is serial dispatch (each batch fully materialized before the
+    next is assembled).  Depth 2 overlaps batch N+1's host-side gather and
+    H2D transfer with batch N's device execution, which is the whole win on
+    a single chip: the device runs one program at a time, so depth 3+ only
+    queues more work behind the same execution stream and adds latency
+    without adding throughput.  Clamped to >=1; a typo'd env value degrades
+    to the default rather than killing serving.
+    """
+    if depth is None:
+        raw = os.environ.get(PIPELINE_DEPTH_ENV, "")
+        try:
+            depth = int(raw) if raw.strip() else DEFAULT_PIPELINE_DEPTH
+        except ValueError:
+            depth = DEFAULT_PIPELINE_DEPTH
+    return max(1, int(depth))
+
+
+class DispatcherClosed(RuntimeError):
+    """The in-flight dispatcher has been permanently shut down."""
+
+
+class InFlightDispatcher:
+    """Bounded multi-in-flight dispatch pipeline over an engine.
+
+    Replaces the lock-scoped dispatch->execute->readback round trip with a
+    pipeline: ``submit(images)`` enqueues a compiled-bucket execution via
+    ``engine.predict_async`` and returns a Future immediately, so the
+    caller starts assembling the NEXT batch while this one executes; a
+    dedicated completion thread materializes results (the blocking device
+    sync) in FIFO dispatch order and resolves each Future.  Backpressure:
+    submit blocks while ``depth`` batches are already in flight, so host
+    assembly can run at most ``depth`` batches ahead of the device.
+
+    Guarantees:
+
+    - **Ordering**: completions happen in submit order (single FIFO
+      completion queue), and each Future resolves to exactly its own
+      batch's rows -- never another caller's.
+    - **Byte-identical results**: the same predict_async + np.asarray
+      materialization path as the engine's own synchronous predict().
+    - **Exception wiring**: a dispatch failure resolves THAT submit's
+      Future with the exception; a device-side failure surfacing at sync
+      resolves the in-flight batch's Future.  Neither kills the pipeline.
+    - **Clean shutdown**: close(drain=True) completes every in-flight
+      batch before the completion thread exits; submits after close raise
+      DispatcherClosed.
+
+    Aliasing contract (inherited from predict_async): a submitted ``images``
+    array must stay unmodified until its Future resolves.  Callers with
+    reusable staging buffers must rotate >= depth+1 buffers.
+
+    Per-stage latency lands in the kdlt_pipeline_*_seconds histograms
+    (utils.metrics.PIPELINE_STAGES documents the stage semantics).
+    """
+
+    def __init__(self, engine, depth: int | None = None,
+                 registry: metrics_lib.Registry | None = None):
+        self._engine = engine
+        self.depth = resolve_pipeline_depth(depth)
+        self._slots = threading.Semaphore(self.depth)
+        import queue as queue_lib
+
+        self._completions: queue_lib.Queue = queue_lib.Queue()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
+        self._m_stage = metrics_lib.pipeline_stage_histograms(registry)
+        self._m_depth = registry.gauge(
+            "kdlt_pipeline_depth", "configured in-flight dispatch depth"
+        )
+        self._m_depth.set(float(self.depth))
+        self._thread = threading.Thread(
+            target=self._complete_loop, name="kdlt-dispatch-readback", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, images: np.ndarray) -> Future:
+        """Dispatch one uint8 batch; returns a Future of its logits rows.
+
+        Blocks only while ``depth`` batches are in flight (backpressure) --
+        never on device execution of the batch itself.
+        """
+        t0 = time.perf_counter()
+        self._slots.acquire()
+        if self._closed:
+            self._slots.release()
+            raise DispatcherClosed("dispatcher is shut down")
+        self._m_stage["enqueue_wait"].observe(time.perf_counter() - t0)
+        fut: Future = Future()
+        t1 = time.perf_counter()
+        try:
+            handle, n = self._engine.predict_async(images)
+        except Exception as e:  # dispatch failure belongs to THIS future
+            self._slots.release()
+            fut.set_exception(e)
+            return fut
+        self._m_stage["dispatch"].observe(time.perf_counter() - t1)
+        self._completions.put((handle, n, fut, time.perf_counter()))
+        return fut
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            self._complete_one(*item)
+
+    def _complete_one(self, handle, n: int, fut: Future, dispatched_at: float) -> None:
+        """MUST NOT raise: an exception escaping here kills the completion
+        thread, which strands every later batch's waiters AND deadlocks
+        close() -- so anything unexpected fails THIS future instead."""
+        t0 = time.perf_counter()
+        try:
+            rows = np.asarray(handle)[:n]  # blocking device sync + D2H
+        except Exception as e:  # device-side failure surfaces at sync
+            self._slots.release()
+            if not fut.cancelled():
+                fut.set_exception(e)
+            return
+        t1 = time.perf_counter()
+        self._m_stage["execute"].observe(t0 - dispatched_at)
+        self._m_stage["readback"].observe(t1 - t0)
+        try:
+            if hasattr(self._engine, "record_completed"):
+                # The engine accounts only its own synchronous path;
+                # pipelined batches report here after materialization
+                # succeeds (failed batches never inflate the counters).
+                self._engine.record_completed(n, t1 - dispatched_at)
+        except Exception:  # noqa: BLE001 - accounting must not stall results
+            pass
+        self._slots.release()
+        try:
+            if not fut.cancelled():
+                fut.set_result(rows)
+        except Exception:  # noqa: BLE001 - cancel race on an abandoned future
+            pass
+
+    def close(self, drain: bool = True) -> None:
+        """Stop intake, drain every in-flight batch, stop the completion
+        thread.
+
+        Quiesces through the slot semaphore: acquiring all ``depth`` slots
+        both waits for in-flight work to finish materializing (each slot is
+        released only after its Future resolves) and blocks any racing
+        submit, which then observes ``_closed`` and raises -- so no Future
+        can be stranded by a close/submit race.  drain=False is accepted
+        for signature symmetry with the batchers but behaves identically:
+        work already dispatched is on the device regardless, so its waiters
+        are always resolved.
+        """
+        del drain
+        with self._close_lock:
+            if self._closed:
+                return
+            for _ in range(self.depth):  # wait out the in-flight batches
+                self._slots.acquire()
+            self._closed = True
+            for _ in range(self.depth):  # wake blocked submits -> they raise
+                self._slots.release()
+        self._completions.put(None)
+        self._thread.join(timeout=30.0)
 
 
 class InferenceEngine:
@@ -412,8 +587,10 @@ class InferenceEngine:
         Aliasing contract: ``images`` must stay unmodified until the result
         is materialized.  Whether jax copies host arrays at dispatch is
         BACKEND-DEPENDENT (the CPU client can alias aligned host memory
-        zero-copy), so a caller with a reusable staging buffer must
-        double-buffer or copy -- see NativeBatcher's ping-pong buffers.
+        zero-copy), so a caller with a reusable staging buffer must rotate
+        depth+1 buffers or copy -- see NativeBatcher's staging-buffer ring.
+        InFlightDispatcher is the general pipelining wrapper over this
+        hook: bounded in-flight depth, FIFO completion thread, futures.
         """
         images = np.asarray(images)
         if images.ndim != 4 or images.shape[1:] != self.spec.input_shape:

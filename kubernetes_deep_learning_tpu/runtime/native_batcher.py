@@ -37,7 +37,12 @@ class NativeBatcher:
         max_delay_ms: float = 2.0,
         queue_cap: int = 2048,
         registry: metrics_lib.Registry | None = None,
+        pipeline_depth: int | None = None,
     ):
+        """``pipeline_depth`` bounds how many dispatched-but-unmaterialized
+        batches ride the device at once (None = $KDLT_PIPELINE_DEPTH or 2;
+        1 = the pre-pipelining behavior of at most one batch in flight
+        while the next assembles)."""
         from kubernetes_deep_learning_tpu.ops import _native
 
         self._lib = _native.lib
@@ -78,12 +83,16 @@ class NativeBatcher:
             "kdlt_batcher_rejected_total", "requests rejected because queue was full"
         )
         # Dispatcher-owned staging buffers; only this thread touches them.
-        # TWO batch buffers, used ping-pong: predict_async's aliasing
-        # contract forbids touching a dispatched batch until its sync, and
-        # with a depth-2 pipeline exactly one batch is in flight while the
-        # next is being assembled.
+        # pipeline_depth + 1 buffers, rotated: predict_async's aliasing
+        # contract forbids touching a dispatched batch until its sync, so
+        # with up to ``pipeline_depth`` batches in flight one more buffer
+        # is needed for the batch being assembled.
+        from kubernetes_deep_learning_tpu.runtime.engine import resolve_pipeline_depth
+
+        self._max_inflight = resolve_pipeline_depth(pipeline_depth)
         self._batch_bufs = [
-            np.empty((self.max_batch, *self._item_shape), np.uint8) for _ in range(2)
+            np.empty((self.max_batch, *self._item_shape), np.uint8)
+            for _ in range(self._max_inflight + 1)
         ]
         self._tickets = np.empty(self.max_batch, np.int64)
         self._thread = threading.Thread(
@@ -94,53 +103,58 @@ class NativeBatcher:
     # --- dispatcher --------------------------------------------------------
 
     def _run(self) -> None:
+        from collections import deque
+
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i64p = ctypes.POINTER(ctypes.c_int64)
         tix = self._tickets.ctypes.data_as(i64p)
-        # Depth-2 pipeline: while the device executes batch N (staged in one
-        # buffer), this thread takes, assembles (into the OTHER buffer), and
-        # DISPATCHES batch N+1, then syncs N.  The device never idles
-        # between batches on dispatch/assembly time (on tunnel-attached dev
-        # chips that hides an entire round trip).
+        # Multi-in-flight pipeline: while the device executes batches
+        # N..N+depth-1 (each staged in its own buffer), this thread takes,
+        # assembles (into a free buffer), and DISPATCHES the next batch,
+        # then syncs the OLDEST in-flight batch only when the depth limit is
+        # reached (backpressure).  The device never idles between batches on
+        # dispatch/assembly time (on tunnel-attached dev chips that hides an
+        # entire round trip); completions stay FIFO in dispatch order.
         use_async = hasattr(self._engine, "predict_async")
-        pending = None  # (tickets_copy, n, device_logits, dispatched_at)
+        pending: deque = deque()  # (tickets_copy, n, device_logits, dispatched_at)
         slot = 0
         while True:
-            # Waits in C (GIL released).  With a batch in flight the wait is
+            # Waits in C (GIL released).  With batches in flight the wait is
             # BOUNDED: on an idle queue the dispatcher must come back to sync
-            # the in-flight batch rather than strand its waiters; take
+            # the in-flight work rather than strand its waiters; take
             # returns -1 when the bounded wait expires with no work.
-            wait_s = self.max_delay if pending is not None else -1.0
+            wait_s = self.max_delay if pending else -1.0
             staging = self._batch_bufs[slot]
             n = self._lib.kdlt_bq_take(
                 self._q, staging.ctypes.data_as(u8p), self.max_batch,
                 self.max_delay, wait_s, tix,
             )
-            if n == -1:  # no new work while a batch is in flight: sync it
-                self._finish(*pending)
-                pending = None
+            if n == -1:  # no new work while batches are in flight: sync one
+                self._finish(*pending.popleft())
                 continue
             if n == 0:
-                if pending is not None:
-                    self._finish(*pending)
+                while pending:
+                    self._finish(*pending.popleft())
                 return
             self._m_batch_size.observe(n)
             tickets = self._tickets[:n].copy()
-            current = None
             try:
                 if use_async:
                     device_logits, _ = self._engine.predict_async(staging[:n])
-                    current = (tickets, n, device_logits, time.perf_counter())
-                    slot ^= 1  # the dispatched buffer is now off-limits
+                    pending.append(
+                        (tickets, n, device_logits, time.perf_counter())
+                    )
+                    # The dispatched buffer is off-limits until its sync;
+                    # rotate to the next free staging buffer.
+                    slot = (slot + 1) % len(self._batch_bufs)
                 else:  # plain engines (tests, wrappers): dispatch+sync now
                     self._finish(
                         tickets, n, self._engine.predict(staging[:n]), None
                     )
             except Exception as e:
                 self._fail(tickets, n, e)
-            if pending is not None:
-                self._finish(*pending)
-            pending = current
+            while len(pending) > self._max_inflight:  # depth backpressure
+                self._finish(*pending.popleft())
 
     def _finish(self, tickets: np.ndarray, n: int, logits, dispatched_at) -> None:
         """Sync a dispatched batch and publish its rows (or its failure).

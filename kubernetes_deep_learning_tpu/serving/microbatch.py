@@ -13,6 +13,15 @@ per-image host cost is tens of microseconds.
 This is the same policy/shape as the model tier's own DynamicBatcher
 (queue + linger + size trigger) applied one tier up; the model tier's
 batcher stays useful for traffic arriving from MANY gateway replicas.
+
+Pipelined flushes: the dispatcher thread hands each assembled batch to a
+small bounded pool (``pipeline_depth`` workers, default 2 -- the same knob
+as the model tier's in-flight dispatch) and immediately assembles the next
+batch, so upstream HTTP round-trip time overlaps gateway-side batch
+assembly exactly the way device execution overlaps H2D in the engine
+pipeline.  Batches are independent (each waiter's future is wired to its
+own batch), so cross-batch completion order does not matter; depth 1
+restores the strictly serial flush loop.
 """
 
 from __future__ import annotations
@@ -55,7 +64,10 @@ class UpstreamMicroBatcher:
         max_batch: int = 64,
         max_delay_ms: float = 2.0,
         max_queue: int = 1024,
+        pipeline_depth: int | None = None,
     ):
+        from kubernetes_deep_learning_tpu.runtime.engine import resolve_pipeline_depth
+
         self._predict_batch = predict_batch
         self.max_batch = max_batch
         self._max_delay_s = max_delay_ms / 1e3
@@ -64,6 +76,19 @@ class UpstreamMicroBatcher:
         self._nonempty = threading.Condition(self._lock)
         self._queue: list[tuple[np.ndarray, str, Future]] = []
         self._closed = False
+        # Up to pipeline_depth upstream flushes in flight; the semaphore is
+        # the backpressure (the dispatcher blocks on a slot before handing
+        # off, so assembly never runs unboundedly ahead of the upstream).
+        # Flushes run on short-lived DAEMON threads rather than a pool:
+        # every thread here must stay daemonic so a wedged upstream can
+        # never block interpreter exit (waiters bail out on their own
+        # RESULT_TIMEOUT_S regardless).
+        self._flush_depth = resolve_pipeline_depth(pipeline_depth)
+        self._flush_slots = (
+            threading.Semaphore(self._flush_depth)
+            if self._flush_depth > 1
+            else None
+        )
         self._thread = threading.Thread(
             target=self._run, name="kdlt-upstream-batcher", daemon=True
         )
@@ -113,6 +138,24 @@ class UpstreamMicroBatcher:
                 del self._queue[: self.max_batch]
             if not batch:
                 continue
+            if self._flush_slots is not None:
+                # Pipelined: block only on a flush SLOT (backpressure at
+                # pipeline_depth in-flight upstream calls), then go straight
+                # back to assembling the next batch while this one rides
+                # the upstream round trip on its own thread.
+                self._flush_slots.acquire()
+                threading.Thread(
+                    target=self._flush, args=(batch,),
+                    name="kdlt-upstream-flush", daemon=True,
+                ).start()
+                continue
+            self._flush(batch)
+
+    def _flush(self, batch) -> None:
+        """One upstream call + fan-out; runs inline (depth 1) or on a
+        flush thread.  Must not raise: an escaping exception would strand
+        a flush slot / kill the dispatcher loop."""
+        try:
             images = np.stack([b[0] for b in batch])
             # Trace the coalesced flush under EVERY member's request id
             # (joined, truncated): with only the first waiter's id, the
@@ -131,7 +174,7 @@ class UpstreamMicroBatcher:
             except BaseException as e:  # noqa: BLE001 - fan the failure out
                 for _, _, fut in batch:
                     fut.set_exception(e)
-                continue
+                return
             # Fan-out must also never kill the dispatcher: a failure here
             # (anything unexpected) resolves the remaining futures with the
             # error instead of leaving waiters blocked forever.
@@ -141,9 +184,23 @@ class UpstreamMicroBatcher:
                 except BaseException as e:  # noqa: BLE001
                     if not fut.done():
                         fut.set_exception(e)
+        finally:
+            if self._flush_slots is not None:
+                self._flush_slots.release()
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
             self._nonempty.notify_all()
         self._thread.join(timeout=5)
+        if self._flush_slots is not None:
+            # The dispatcher thread has exited, so no new flushes start;
+            # drain the in-flight ones with a BOUNDED wait -- a wedged
+            # upstream must not turn close() into a hang (its waiters
+            # resolve via their own timeout, and the flush thread is
+            # daemonic so it cannot pin the process either).
+            deadline = time.monotonic() + 10.0
+            for _ in range(self._flush_depth):
+                self._flush_slots.acquire(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )

@@ -47,9 +47,12 @@ import numpy as np
 from kubernetes_deep_learning_tpu.export import artifact as art
 from kubernetes_deep_learning_tpu.runtime import (
     BatcherClosed,
+    DispatcherClosed,
     InferenceEngine,
+    InFlightDispatcher,
     QueueFull,
     create_batcher,
+    resolve_pipeline_depth,
 )
 from kubernetes_deep_learning_tpu.serving.tracing import (
     REQUEST_ID_HEADER,
@@ -69,6 +72,7 @@ class ServedModel:
     def __init__(
         self, artifact, buckets, max_delay_ms, registry, use_batcher=True,
         batcher_impl="auto", mesh=None, mesh_mode="data", engine_factory=None,
+        pipeline_depth=None,
     ):
         # engine_factory: swap the execution engine (default InferenceEngine).
         # runtime.stub.StubEngine measures the host path with the device
@@ -88,12 +92,28 @@ class ServedModel:
                 artifact, buckets=buckets, registry=self.registry_child,
                 mesh=mesh, mesh_mode=mesh_mode,
             )
+            # ONE in-flight dispatch pipeline per model version, shared by
+            # the single-image batcher and the chunked multi-image path, so
+            # both draw from the same bounded in-flight budget (the device
+            # runs one program at a time regardless of which path enqueued
+            # it).  None when depth=1 (serial) or the engine has no async
+            # dispatch hook (e.g. the plain StubEngine).
+            depth = resolve_pipeline_depth(pipeline_depth)
+            self.dispatcher = (
+                InFlightDispatcher(
+                    self.engine, depth=depth, registry=self.registry_child
+                )
+                if depth > 1 and hasattr(self.engine, "predict_async")
+                else None
+            )
             self.batcher = (
                 create_batcher(
                     self.engine,
                     impl=batcher_impl,
                     max_delay_ms=max_delay_ms,
                     registry=self.registry_child,
+                    pipeline_depth=depth,
+                    dispatcher=self.dispatcher,
                 )
                 if use_batcher
                 else None
@@ -128,7 +148,19 @@ class ServedModel:
             return self.engine.predict(images)
         # Batches beyond the bucket ladder are served in max-bucket chunks
         # rather than erroring: the client's batch size should not have to
-        # know this server's compiled shapes.
+        # know this server's compiled shapes.  With the pipeline on, the
+        # chunks ride the shared dispatcher so chunk i+1's H2D overlaps
+        # chunk i's execution instead of serializing dispatch->sync per
+        # chunk; the futures keep per-chunk order for the concatenate.
+        if self.dispatcher is not None and images.dtype == np.uint8:
+            try:
+                futs = [
+                    self.dispatcher.submit(images[i : i + max_b])
+                    for i in range(0, images.shape[0], max_b)
+                ]
+                return np.concatenate([f.result(timeout=120.0) for f in futs])
+            except DispatcherClosed:
+                pass  # hot reload race: fall through to the serial engine path
         return np.concatenate(
             [
                 self.engine.predict(images[i : i + max_b])
@@ -136,9 +168,14 @@ class ServedModel:
             ]
         )
 
-    def close(self) -> None:
+    def close(self, drain: bool = True) -> None:
         if self.batcher is not None:
-            self.batcher.close(drain=True)
+            self.batcher.close(drain=drain)
+        if self.dispatcher is not None:
+            # After the batcher's dispatch thread exits, only in-flight
+            # handler threads can race this close; they fall back to the
+            # engine path on DispatcherClosed.
+            self.dispatcher.close(drain=drain)
 
 
 class ModelServer:
@@ -156,6 +193,7 @@ class ModelServer:
         profile_base: str | None = "",
         request_log: bool = False,
         engine_factory=None,
+        pipeline_depth: int | None = None,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -195,6 +233,7 @@ class ModelServer:
         self._mesh = mesh
         self._mesh_mode = mesh_mode
         self._engine_factory = engine_factory
+        self._pipeline_depth = pipeline_depth
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self._profile_lock = threading.Lock()
@@ -280,6 +319,7 @@ class ModelServer:
                     self._mesh,
                     self._mesh_mode,
                     self._engine_factory,
+                    self._pipeline_depth,
                 )
                 fresh.engine.warmup()
             except Exception as e:
@@ -525,8 +565,7 @@ class ModelServer:
             self._httpd.shutdown()
         self._httpd.server_close()
         for m in self.models.values():
-            if m.batcher is not None:
-                m.batcher.close(drain=False)
+            m.close(drain=False)
 
 
 def _serve_cross_host(args) -> int:
@@ -637,6 +676,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=DEFAULT_PORT)
     p.add_argument("--buckets", default="1,2,4,8,16,32,64,128")
     p.add_argument("--max-delay-ms", type=float, default=2.0)
+    p.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=0,
+        help="max batches in flight on the device (dispatch pipelining): "
+        "batch N+1's host gather + H2D overlap batch N's execution.  "
+        "0 = $KDLT_PIPELINE_DEPTH or the default 2; 1 = serial dispatch.  "
+        "Depth > 2 buys nothing on one chip (one program executes at a "
+        "time); it only queues latency",
+    )
     p.add_argument("--no-batching", action="store_true")
     p.add_argument(
         "--batcher",
@@ -788,6 +837,7 @@ def main(argv: list[str] | None = None) -> int:
         mesh_mode=args.parallel_mode,
         profile_base=None if args.no_profiling else args.profile_dir,
         request_log=not args.no_request_log,
+        pipeline_depth=args.pipeline_depth or None,
     )
     server.warmup()
     if args.watch_interval > 0:

@@ -45,11 +45,18 @@ def resolve_cache_dir(cache_dir: str | None = None,
     sentinel: a programmatic caller (a test, exp/cache_restart.py) that
     passes a directory has stated intent more specifically than a
     lingering env var.
+
+    An EMPTY ``KDLT_COMPILE_CACHE_DIR`` is treated as UNSET, not as a
+    disable sentinel: k8s manifests commonly template the var to "" to
+    mean "no override", and silently disabling the cache there also
+    suppressed the ``JAX_COMPILATION_CACHE_DIR`` fallback and the
+    caller's default (ADVICE r5).  Disabling requires the explicit
+    ``off``/``none``/``0`` sentinels.
     """
     if cache_dir:
         return cache_dir
     env = os.environ.get(ENV_VAR)
-    if env is not None and env.strip().lower() in ("", "off", "none", "0"):
+    if env is not None and env.strip().lower() in ("off", "none", "0"):
         return None
     return env or os.environ.get(JAX_ENV_VAR) or default_dir
 

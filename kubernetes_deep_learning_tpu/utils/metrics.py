@@ -17,6 +17,50 @@ DEFAULT_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
 )
 
+# Pipeline-stage buckets reach below the request buckets: the dispatch and
+# readback stages of a well-overlapped pipeline are tens of microseconds to
+# single-digit milliseconds, which DEFAULT_BUCKETS would collapse into its
+# first bin.
+PIPELINE_STAGE_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 1.0, 5.0,
+)
+
+# The in-flight dispatch pipeline's stages (runtime.engine.InFlightDispatcher),
+# in hot-path order.  Stage semantics under JAX async dispatch:
+#
+# - enqueue_wait: submit() blocked waiting for an in-flight slot -- the
+#   backpressure stage; nonzero means the device (not the host) is the
+#   bottleneck, which is the healthy steady state.
+# - dispatch: host batch assembly + uint8 H2D transfer ENQUEUE (the
+#   predict_async call).  JAX returns as soon as the transfer+execution are
+#   queued, so this is pure host cost -- the part pipelining hides.
+# - execute: dispatch-return -> readback-start on the completion thread.
+#   Under overlap this is the time the batch waited in flight while the
+#   device worked (on it or its predecessors).
+# - readback: the blocking materialization (device sync + D2H copy).
+PIPELINE_STAGES = (
+    ("enqueue_wait", "submit blocked on the in-flight depth limit (backpressure)"),
+    ("dispatch", "host batch assembly + H2D transfer enqueue (predict_async)"),
+    ("execute", "in-flight wait: dispatch return to readback start (overlapped device execution)"),
+    ("readback", "blocking device sync + D2H materialization"),
+)
+
+
+def pipeline_stage_histograms(registry: "Registry") -> dict:
+    """The per-stage histograms every in-flight dispatcher emits.
+
+    Centralized so the dispatcher, the bench A/B mode, and any future
+    pipelined caller emit the SAME series names (kdlt_pipeline_<stage>_seconds)
+    and dashboards/alerts need one set of queries.
+    """
+    return {
+        stage: registry.histogram(
+            f"kdlt_pipeline_{stage}_seconds", help, buckets=PIPELINE_STAGE_BUCKETS
+        )
+        for stage, help in PIPELINE_STAGES
+    }
+
 
 def _fmt_labels(labels: dict[str, str] | None, extra: str = "") -> str:
     parts = [f'{k}="{v}"' for k, v in (labels or {}).items()]

@@ -137,6 +137,50 @@ def test_microbatcher_coalesces_and_routes():
     assert max(calls) > 1  # coalescing actually happened
 
 
+def test_microbatcher_overlaps_flushes_up_to_pipeline_depth():
+    """Pipelined flushes: with depth 2 the dispatcher must START upstream
+    flush N+1 while flush N is still in flight (held open here by an
+    event), and block at the depth limit -- the gateway-tier mirror of the
+    engine's in-flight dispatch pipeline."""
+    import time
+
+    started = []
+    release = threading.Event()
+    labels = ["a", "b"]
+
+    def predict_batch(images, request_id):
+        started.append(images.shape[0])
+        release.wait(5)  # every flush holds until the test releases
+        return [img.sum() * np.ones(2) for img in images], labels
+
+    mb = UpstreamMicroBatcher(
+        predict_batch, max_batch=1, max_delay_ms=0.0, pipeline_depth=2
+    )
+    imgs = [np.full((2, 2, 3), i, np.uint8) for i in range(4)]
+    results: list = [None] * len(imgs)
+    threads = [
+        threading.Thread(target=lambda i=i: results.__setitem__(i, mb.predict(imgs[i])))
+        for i in range(len(imgs))
+    ]
+    for t in threads:
+        t.start()
+    # Two flushes must be IN FLIGHT concurrently (neither has returned)...
+    deadline = time.monotonic() + 5
+    while len(started) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(started) == 2
+    # ...and the third must be held back by the depth-2 slot limit.
+    time.sleep(0.1)
+    assert len(started) == 2
+    release.set()
+    for t in threads:
+        t.join()
+    mb.close()
+    for i, img in enumerate(imgs):
+        row, _ = results[i]
+        np.testing.assert_array_equal(row, img.sum() * np.ones(2))
+
+
 def test_microbatcher_propagates_upstream_failure():
     def predict_batch(images, request_id):
         raise RuntimeError("upstream down")
