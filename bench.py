@@ -1213,6 +1213,181 @@ def bench_pipeline_ab(n_batches=150, batch=16, host_ms=3.0, device_ms=10.0,
     return out, 0 if ok else 1
 
 
+def bench_overload_ab(duration_s=8.0, device_ms=100.0, deadline_ms=600.0,
+                      rate_x=2.0, buckets=(1, 2), max_delay_ms=2.0):
+    """Admission control A/B under overload: goodput with vs without.
+
+    Device-free acceptance harness for serving.admission.  A REAL
+    ModelServer fronts a StubEngine whose predict sleeps ``device_ms`` per
+    batch (GIL-free, like a device wait), so the tier's capacity is known by
+    construction: max_bucket / device_ms images/sec.  An open-loop client
+    fires single-image predicts at ``rate_x`` times that capacity for
+    ``duration_s`` -- each request carrying a ``deadline_ms`` budget in the
+    X-Request-Deadline-Ms header -- once against a server with admission ON
+    and once with admission OFF (the legacy posture: header ignored, no
+    shedding, fixed 20 s batcher wait).
+
+    Open-loop semantics: latency is measured from each request's SCHEDULED
+    send time, so server-side backlog counts against it exactly as a real
+    client would experience.  Goodput = completions within their deadline
+    per second.  Without admission every request queues and degrades
+    together (the ramping backlog pushes all but the earliest past the
+    deadline); with admission the tiers shed what they cannot finish and
+    the admitted work completes inside its budget.
+
+    Returns (json_dict, rc); rc=0 iff goodput(admission) >=
+    goodput(baseline) AND in-deadline p99(admission) < p99(baseline).
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    spec = register_spec(
+        ModelSpec(
+            name="overload-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    buckets = tuple(sorted(buckets))
+    capacity_rps = buckets[-1] / (device_ms / 1e3)
+    offered_rps = rate_x * capacity_rps
+    deadline_s = deadline_ms / 1e3
+    n_requests = int(duration_s * offered_rps)
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(1, *spec.input_shape), dtype=np.uint8)
+    body = protocol.encode_predict_request(img)
+    log(
+        f"overload A/B: stub capacity {capacity_rps:.0f} img/s "
+        f"({buckets[-1]}-bucket / {device_ms}ms), offered {offered_rps:.0f} "
+        f"req/s x {duration_s}s = {n_requests} requests, deadline "
+        f"{deadline_ms:.0f}ms per request"
+    )
+
+    def run_arm(admission_on: bool) -> dict:
+        root = tempfile.mkdtemp(prefix="kdlt-overload-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        server = ModelServer(
+            root, port=0, buckets=buckets, max_delay_ms=max_delay_ms,
+            host="127.0.0.1",
+            engine_factory=lambda a, **kw: StubEngine(
+                a, device_ms_per_batch=device_ms, **kw
+            ),
+            admission=admission_on,
+        )
+        server.warmup()
+        server.start()
+        url = f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict"
+        headers = {
+            "Content-Type": protocol.MSGPACK_CONTENT_TYPE,
+            DEADLINE_HEADER: f"{deadline_ms:.1f}",
+        }
+        session = requests.Session()
+        session.mount("http://", requests.adapters.HTTPAdapter(
+            pool_connections=4, pool_maxsize=1024,
+        ))
+        results: list = [None] * n_requests
+
+        def fire(i: int, at: float) -> None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                r = session.post(url, data=body, headers=headers, timeout=30.0)
+                status = r.status_code
+            except Exception:
+                status = -1
+            # Open-loop latency: measured from the SCHEDULED send time.
+            results[i] = (time.monotonic() - at, status)
+
+        t_base = time.monotonic() + 0.25
+        threads = [
+            threading.Thread(
+                target=fire, args=(i, t_base + i / offered_rps), daemon=True
+            )
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        # Give stragglers a bounded grace past the send window, then force
+        # the end: shutdown fails the still-queued waiters fast (their
+        # latency is far past the deadline either way, so the goodput and
+        # in-deadline percentiles are already decided).
+        end_by = t_base + duration_s + max(2.0, 4 * deadline_s)
+        for t in threads:
+            t.join(timeout=max(0.0, end_by - time.monotonic()))
+        server.shutdown()
+        for t in threads:
+            t.join(timeout=10.0)
+        done = [r for r in results if r is not None]
+        ok_lat = sorted(lat for lat, status in done if status == 200)
+        in_deadline = [lat for lat in ok_lat if lat <= deadline_s]
+        shed = sum(1 for _, status in done if status in (503, 504))
+        arm = {
+            "offered_rps": round(offered_rps, 1),
+            "completed_200": len(ok_lat),
+            "shed_5xx": shed,
+            "unresolved": n_requests - len(done),
+            "goodput_rps": round(len(in_deadline) / duration_s, 2),
+            "p99_in_deadline_ms": (
+                round(float(np.percentile(in_deadline, 99)) * 1e3, 1)
+                if in_deadline else float("inf")
+            ),
+            "p50_in_deadline_ms": (
+                round(float(np.percentile(in_deadline, 50)) * 1e3, 1)
+                if in_deadline else float("inf")
+            ),
+            "p99_all_completions_ms": (
+                round(float(np.percentile(ok_lat, 99)) * 1e3, 1)
+                if ok_lat else float("inf")
+            ),
+        }
+        log(
+            f"  admission={'on ' if admission_on else 'off'}: "
+            f"goodput {arm['goodput_rps']:7.2f}/s of {offered_rps:.0f} offered, "
+            f"{arm['completed_200']} x 200 ({len(in_deadline)} in-deadline), "
+            f"{shed} shed, in-deadline p99 {arm['p99_in_deadline_ms']} ms, "
+            f"all-200 p99 {arm['p99_all_completions_ms']} ms"
+        )
+        return arm
+
+    arm_on = run_arm(True)
+    arm_off = run_arm(False)
+    ok = (
+        arm_on["goodput_rps"] >= arm_off["goodput_rps"]
+        and arm_on["p99_in_deadline_ms"] < arm_off["p99_in_deadline_ms"]
+    )
+    ratio = arm_on["goodput_rps"] / max(arm_off["goodput_rps"], 1e-9)
+    out = {
+        "metric": (
+            f"admission-control overload A/B (stub backend, capacity "
+            f"{capacity_rps:.0f} req/s, {rate_x:g}x offered load, "
+            f"{deadline_ms:.0f}ms deadline): goodput ratio admission-on / "
+            f"admission-off; in-deadline p99 "
+            f"{arm_on['p99_in_deadline_ms']} vs {arm_off['p99_in_deadline_ms']} ms"
+        ),
+        "value": round(ratio, 2),
+        "unit": "x goodput (in-deadline completions/s)",
+        "vs_baseline": round(ratio, 2),
+        "capacity_rps": round(capacity_rps, 1),
+        "deadline_ms": deadline_ms,
+        "rate_x": rate_x,
+        "arms": {"admission": arm_on, "baseline": arm_off},
+    }
+    return out, 0 if ok else 1
+
+
 def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl,
                           max_delay_ms, stub_device_ms=0.0):
     """Can the HTTP + protocol + batcher host path carry the target WITHOUT
@@ -1524,6 +1699,32 @@ def main() -> int:
              "reads as a pipeline gap)",
     )
     p.add_argument(
+        "--overload-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: admission-control A/B -- drive a "
+             "stub-backed model tier at --overload-rate-x times its known "
+             "capacity for this many seconds per arm (admission on vs off) "
+             "and report goodput (in-deadline completions/s) plus "
+             "in-deadline p99 (no device needed; rc=0 iff admission wins "
+             "on both)",
+    )
+    p.add_argument(
+        "--overload-device-ms", type=float, default=100.0,
+        help="simulated device ms per batch for --overload-ab (sets the "
+             "tier's capacity: max-bucket / device-ms)",
+    )
+    p.add_argument(
+        "--overload-deadline-ms", type=float, default=600.0,
+        help="per-request deadline budget for --overload-ab",
+    )
+    p.add_argument(
+        "--overload-rate-x", type=float, default=2.0,
+        help="offered load as a multiple of the stub tier's capacity",
+    )
+    p.add_argument(
+        "--overload-buckets", default="1,2",
+        help="bucket ladder for the --overload-ab stub tier",
+    )
+    p.add_argument(
         "--dry-run", action="store_true",
         help="parse arguments, echo the resolved run configuration as one "
              "JSON line, and exit 0 -- a CI smoke so bench refactors can "
@@ -1573,7 +1774,7 @@ def main() -> int:
         # line; no jax import, no device dial, no subprocesses.
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "batcher_sweep",
-                     "host_saturation"):
+                     "host_saturation", "overload_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -1589,6 +1790,12 @@ def main() -> int:
             "point_timeout": args.point_timeout,
             "budget_s": args.budget_s,
             "isolate": not args.no_isolate,
+            "overload": {
+                "device_ms": args.overload_device_ms,
+                "deadline_ms": args.overload_deadline_ms,
+                "rate_x": args.overload_rate_x,
+                "buckets": [int(b) for b in args.overload_buckets.split(",")],
+            },
         }), flush=True)
         return 0
 
@@ -1630,6 +1837,18 @@ def main() -> int:
             host_ms=args.pipeline_ab_host_ms,
             device_ms=args.pipeline_ab_device_ms,
             depths=tuple(int(d) for d in args.pipeline_ab_depths.split(",")),
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.overload_ab > 0:
+        out, rc = bench_overload_ab(
+            duration_s=args.overload_ab,
+            device_ms=args.overload_device_ms,
+            deadline_ms=args.overload_deadline_ms,
+            rate_x=args.overload_rate_x,
+            buckets=tuple(int(b) for b in args.overload_buckets.split(",")),
+            max_delay_ms=args.max_delay_ms,
         )
         print(json.dumps(out), flush=True)
         return rc

@@ -1,0 +1,96 @@
+"""Propagated deadline budgets: one clock from client edge to batcher.
+
+The reference's only latency control is a fixed 20 s gRPC deadline at the
+gateway (reference model_server.py:55); every queue and upstream call below
+it waits on its own unrelated constant, so a request can keep consuming
+gateway threads, batcher slots, and TPU time long after its caller has
+given up.  Here a request carries its REMAINING budget in the
+``X-Request-Deadline-Ms`` header: the client states a total budget, the
+gateway converts it to an absolute monotonic deadline, and every hop down
+(upstream HTTP call, model-tier admission, batcher future wait) re-derives
+its timeout from what is left -- Clockwork-style (OSDI '20): work that
+cannot finish inside its deadline is rejected as early as possible instead
+of executed uselessly.
+
+Absent or unparsable headers fall back to the reference-compatible default
+budget (``KDLT_ADMISSION_DEFAULT_DEADLINE_MS``, 20 s), so deadline-unaware
+clients see exactly the legacy behavior; client-supplied values are capped
+(``KDLT_ADMISSION_MAX_DEADLINE_MS``) so a hostile header cannot pin server
+resources for an hour.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+DEADLINE_HEADER = "X-Request-Deadline-Ms"
+WSGI_DEADLINE_KEY = "HTTP_X_REQUEST_DEADLINE_MS"
+
+DEFAULT_DEADLINE_MS_ENV = "KDLT_ADMISSION_DEFAULT_DEADLINE_MS"
+MAX_DEADLINE_MS_ENV = "KDLT_ADMISSION_MAX_DEADLINE_MS"
+DEFAULT_DEADLINE_MS = 20_000.0  # the reference's 20 s deadline, as a budget
+MAX_DEADLINE_MS = 300_000.0
+
+
+def _env_ms(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+class Deadline:
+    """An absolute monotonic deadline, created from a remaining-ms budget.
+
+    Absolute internally (so elapsed time anywhere in the pipeline is
+    automatically charged against it), relative on the wire (clock skew
+    between tiers must not corrupt the budget -- the header always carries
+    remaining milliseconds, re-measured at send time).
+    """
+
+    __slots__ = ("budget_s", "_deadline")
+
+    def __init__(self, budget_s: float, now: float | None = None):
+        self.budget_s = budget_s
+        self._deadline = (time.monotonic() if now is None else now) + budget_s
+
+    @classmethod
+    def default(cls) -> "Deadline":
+        return cls(_env_ms(DEFAULT_DEADLINE_MS_ENV, DEFAULT_DEADLINE_MS) / 1e3)
+
+    @classmethod
+    def from_header(cls, raw: str | None) -> "Deadline":
+        """Parse ``X-Request-Deadline-Ms``; absent/garbage -> the default
+        budget, oversized values capped, and a non-positive value becomes an
+        already-exhausted deadline (the sender spent the budget upstream;
+        admission rejects it before it touches the TPU)."""
+        if raw is None or not str(raw).strip():
+            return cls.default()
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            return cls.default()
+        ms = min(ms, _env_ms(MAX_DEADLINE_MS_ENV, MAX_DEADLINE_MS))
+        return cls(max(ms, 0.0) / 1e3)
+
+    def remaining_s(self) -> float:
+        return self._deadline - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining_s() * 1e3
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def header_value(self) -> str:
+        """The remaining budget, as the wire header value (re-measured now)."""
+        return f"{max(self.remaining_ms(), 0.0):.1f}"
+
+    def clamp(self, timeout_s: float, floor_s: float = 0.001) -> float:
+        """``timeout_s`` shrunk to the remaining budget (never below
+        ``floor_s``: a zero/negative socket timeout means 'wait forever' or
+        raises, neither of which is 'fail fast')."""
+        return max(floor_s, min(timeout_s, self.remaining_s()))

@@ -1,0 +1,187 @@
+"""Adaptive concurrency limiter + bounded admission queue (AIMD).
+
+The static alternative -- a fixed thread/queue cap -- is wrong in both
+directions on a serving tier whose per-request cost varies with batch
+shape, model, and device health.  This limiter learns the sustainable
+concurrency the way TCP learns a path's bandwidth:
+
+- **Additive increase**: every clean completion grows the limit by
+  ``1/limit`` (≈ +1 per round of in-flight completions).
+- **Multiplicative decrease**: an observed-latency overload signal -- the
+  caller saw a deadline miss, a full downstream queue, or an upstream 503
+  while holding the slot (Ticket.mark_overloaded), or the admission-queue
+  wait exceeded an explicit target (``KDLT_ADMISSION_TARGET_QUEUE_MS``,
+  off by default: on a device-bound tier queueing is where waiting
+  BELONGS, so only budget-relative misses are unambiguous congestion) --
+  shrinks the limit by ``decrease`` (default x0.9), at most once per
+  ``cooldown_s`` so one burst's worth of misses counts as ONE congestion
+  event, not thirty.
+
+Requests beyond the limit wait in a bounded queue -- but never for their
+whole deadline: the wait is capped at ``queue_wait_fraction`` (default a
+quarter) of the remaining budget, so an admitted request always keeps the
+bulk of its budget for actual execution (one that burned its budget
+queueing would be admitted only to miss its deadline on the device, the
+worst of both worlds).  Beyond ``queue_cap`` waiters, or past the wait
+bound, the request sheds with a distinct reason so dashboards can tell
+"queue overflowed" from "queue too slow".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from kubernetes_deep_learning_tpu.serving.admission.shed import Shed
+
+MAX_CONCURRENCY_ENV = "KDLT_ADMISSION_MAX_CONCURRENCY"
+MIN_CONCURRENCY_ENV = "KDLT_ADMISSION_MIN_CONCURRENCY"
+INITIAL_CONCURRENCY_ENV = "KDLT_ADMISSION_INITIAL_CONCURRENCY"
+QUEUE_CAP_ENV = "KDLT_ADMISSION_QUEUE_CAP"
+TARGET_QUEUE_MS_ENV = "KDLT_ADMISSION_TARGET_QUEUE_MS"
+MAX_QUEUE_WAIT_MS_ENV = "KDLT_ADMISSION_MAX_QUEUE_WAIT_MS"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+class AdaptiveLimiter:
+    def __init__(
+        self,
+        min_limit: float | None = None,
+        max_limit: float | None = None,
+        initial: float | None = None,
+        target_wait_s: float | None = None,
+        queue_cap: int | None = None,
+        max_queue_wait_s: float | None = None,
+        queue_wait_fraction: float = 0.25,
+        decrease: float = 0.9,
+        cooldown_s: float = 0.1,
+    ):
+        self.min_limit = min_limit if min_limit is not None else max(
+            1.0, _env_float(MIN_CONCURRENCY_ENV, 1.0)
+        )
+        self.max_limit = max_limit if max_limit is not None else _env_float(
+            MAX_CONCURRENCY_ENV, 64.0
+        )
+        self._limit = float(
+            initial if initial is not None
+            else _env_float(INITIAL_CONCURRENCY_ENV, 8.0)
+        )
+        self._limit = min(max(self._limit, self.min_limit), self.max_limit)
+        # 0 disables the absolute-target decrease signal (the default): the
+        # budget-relative signals (queue_wait_fraction bound + the caller's
+        # mark_overloaded) adapt to each request's own deadline instead of
+        # a one-size constant.
+        self.target_wait_s = (
+            target_wait_s if target_wait_s is not None
+            else _env_float(TARGET_QUEUE_MS_ENV, 0.0) / 1e3
+        )
+        self.queue_cap = int(
+            queue_cap if queue_cap is not None else _env_float(QUEUE_CAP_ENV, 128)
+        )
+        # The absolute ceiling exists so a request with NO deadline (legacy
+        # client, admission-on server) cannot park forever; deadline-carrying
+        # requests are bounded tighter by queue_wait_fraction of their budget.
+        self.max_queue_wait_s = (
+            max_queue_wait_s if max_queue_wait_s is not None
+            else _env_float(MAX_QUEUE_WAIT_MS_ENV, 10_000.0) / 1e3
+        )
+        self.queue_wait_fraction = queue_wait_fraction
+        self._decrease = decrease
+        self._cooldown_s = cooldown_s
+        self._last_decrease = 0.0
+        self._inflight = 0
+        self._waiters = 0
+        self._cond = threading.Condition()
+
+    @property
+    def limit(self) -> float:
+        return self._limit
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def _slots_full(self) -> bool:
+        return self._inflight >= max(1, int(self._limit))
+
+    def acquire(self, budget_s: float | None = None) -> float:
+        """Take a concurrency slot; returns the queue wait in seconds.
+
+        ``budget_s`` is the request's remaining deadline; the wait is
+        bounded by ``queue_wait_fraction`` of it (and the absolute
+        ``max_queue_wait_s``) so a queued request keeps enough budget to
+        actually execute.  Raises Shed("queue_full") when the waiter cap is
+        hit, Shed("queue_timeout") when no slot frees inside the bound.
+        """
+        with self._cond:
+            if not self._slots_full():
+                self._inflight += 1
+                return 0.0
+            if self._waiters >= self.queue_cap:
+                raise Shed(
+                    "queue_full",
+                    retry_after_s=max(self.target_wait_s, 0.05),
+                    detail=f"admission queue at its {self.queue_cap}-waiter cap",
+                )
+            bound = self.max_queue_wait_s
+            if budget_s is not None:
+                bound = min(bound, max(0.0, budget_s) * self.queue_wait_fraction)
+            t0 = time.monotonic()
+            giveup = t0 + bound
+            self._waiters += 1
+            try:
+                while self._slots_full():
+                    remaining = giveup - time.monotonic()
+                    if remaining <= 0:
+                        raise Shed(
+                            "queue_timeout",
+                            retry_after_s=max(self.target_wait_s, 0.05),
+                            detail=(
+                                f"no concurrency slot freed within "
+                                f"{bound * 1e3:.0f}ms (limit {self._limit:.1f})"
+                            ),
+                        )
+                    self._cond.wait(remaining)
+            finally:
+                self._waiters -= 1
+            self._inflight += 1
+            return time.monotonic() - t0
+
+    def release(
+        self,
+        queue_wait_s: float = 0.0,
+        overloaded: bool = False,
+        headroom: bool = True,
+    ) -> None:
+        """Free the slot and feed the AIMD controller.
+
+        ``overloaded`` is the caller's downstream congestion signal
+        (deadline miss / queue full / upstream 503); a queue wait above the
+        explicit target is the local one.  ``headroom=False`` marks a
+        completion that made it but without comfortable budget to spare:
+        it neither grows nor shrinks the limit.  The hold band between
+        "fast enough to grow" and "slow enough to shrink" is what keeps the
+        equilibrium stable -- grow-on-every-success alone ratchets the
+        limit up between cooldown-capped decreases until every completion
+        rides the deadline ceiling.
+        """
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            now = time.monotonic()
+            if overloaded or (
+                self.target_wait_s > 0 and queue_wait_s > self.target_wait_s
+            ):
+                if now - self._last_decrease >= self._cooldown_s:
+                    self._limit = max(self.min_limit, self._limit * self._decrease)
+                    self._last_decrease = now
+            elif headroom:
+                self._limit = min(self.max_limit, self._limit + 1.0 / max(self._limit, 1.0))
+            self._cond.notify()

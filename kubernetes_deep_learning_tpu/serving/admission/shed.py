@@ -1,0 +1,43 @@
+"""Shed: the typed rejection every admission decision point raises.
+
+A shed is not an error in the request (4xx) and not a server fault (500):
+it is the tier protecting its goodput by refusing work it cannot finish
+usefully -- DAGOR-style overload control (SoCC '18).  Each shed carries a
+machine-readable ``reason`` (one of utils.metrics.ADMISSION_SHED_REASONS),
+the HTTP status to map it to (503 for retryable overload, 504 for an
+already-exhausted deadline budget), and an optional ``retry_after_s`` hint
+surfaced as a ``Retry-After`` response header so well-behaved clients
+(serving.client) back off instead of hammering a saturated tier.
+"""
+
+from __future__ import annotations
+
+RETRY_AFTER_HEADER = "Retry-After"
+
+
+class Shed(RuntimeError):
+    """The request was refused by admission control, not failed by it."""
+
+    def __init__(
+        self,
+        reason: str,
+        http_status: int = 503,
+        retry_after_s: float | None = None,
+        detail: str = "",
+    ):
+        super().__init__(detail or f"request shed ({reason})")
+        self.reason = reason
+        self.http_status = http_status
+        self.retry_after_s = retry_after_s
+
+    def headers(self) -> dict[str, str]:
+        """The extra response headers this shed mandates."""
+        return retry_after_headers(self.retry_after_s)
+
+
+def retry_after_headers(retry_after_s: float | None) -> dict[str, str]:
+    """``Retry-After`` as decimal seconds (fractional; our client parses
+    float, and proxies that insist on integers still read the magnitude)."""
+    if retry_after_s is None:
+        return {}
+    return {RETRY_AFTER_HEADER: f"{max(0.0, retry_after_s):.3f}"}

@@ -9,7 +9,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
 import sys
+import time
 
 import numpy as np
 
@@ -18,14 +20,58 @@ from kubernetes_deep_learning_tpu.serving import protocol
 # The reference's canonical test image (reference test.py:4).
 DEFAULT_IMAGE_URL = "http://bit.ly/mlbookcamp-pants"
 
+# Retry budget for 503 shed responses: the server's Retry-After is honored
+# but never beyond this cap (a confused server must not park the client),
+# and jitter decorrelates a thundering herd of retriers.
+RETRY_AFTER_CAP_S = 5.0
+DEFAULT_RETRY_BACKOFF_S = 0.05
 
-def predict_url(gateway_url: str, image_url: str, timeout: float = 30.0) -> dict:
-    """POST {"url": ...} to the gateway's /predict (reference test.py:15)."""
+
+def predict_url(
+    gateway_url: str,
+    image_url: str,
+    timeout: float = 30.0,
+    retries: int = 2,
+    deadline_ms: float | None = None,
+) -> dict:
+    """POST {"url": ...} to the gateway's /predict (reference test.py:15).
+
+    A 503 is the serving tiers' explicit transient shed signal (admission
+    queue full, draining replica, open circuit breaker), so instead of
+    raising immediately the client retries up to ``retries`` times, sleeping
+    for the server's ``Retry-After`` hint (capped, jittered) -- but never
+    past its own ``timeout`` budget.  ``deadline_ms`` states an end-to-end
+    deadline budget via the X-Request-Deadline-Ms header; the serving path
+    then derives every queue wait and upstream timeout from what remains.
+    """
     import requests
 
-    r = requests.post(f"{gateway_url}/predict", json={"url": image_url}, timeout=timeout)
-    r.raise_for_status()
-    return r.json()
+    headers = {}
+    if deadline_ms is not None:
+        from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+
+        headers[DEADLINE_HEADER] = f"{float(deadline_ms):.1f}"
+    t0 = time.monotonic()
+    for attempt in range(retries + 1):
+        r = requests.post(
+            f"{gateway_url}/predict",
+            json={"url": image_url},
+            headers=headers,
+            timeout=timeout,
+        )
+        if r.status_code != 503 or attempt >= retries:
+            r.raise_for_status()
+            return r.json()
+        try:
+            retry_after = float(r.headers.get("Retry-After", ""))
+        except (TypeError, ValueError):
+            retry_after = DEFAULT_RETRY_BACKOFF_S
+        delay = min(max(retry_after, 0.0), RETRY_AFTER_CAP_S)
+        delay += random.uniform(0.0, delay * 0.25 + 0.01)  # decorrelate herds
+        if time.monotonic() - t0 + delay > timeout:
+            r.raise_for_status()  # out of budget: surface the 503
+        time.sleep(delay)
+    raise AssertionError("unreachable")  # loop always returns or raises
 
 
 def predict_images(
@@ -50,8 +96,19 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description="gateway smoke test (test.py equivalent)")
     p.add_argument("--gateway", default="http://localhost:9696")
     p.add_argument("--image-url", default=DEFAULT_IMAGE_URL)
+    p.add_argument(
+        "--deadline-ms", type=float, default=None,
+        help="end-to-end deadline budget propagated via X-Request-Deadline-Ms",
+    )
+    p.add_argument(
+        "--retries", type=int, default=2,
+        help="bounded retries on 503 shed responses (honors Retry-After)",
+    )
     args = p.parse_args(argv)
-    scores = predict_url(args.gateway, args.image_url)
+    scores = predict_url(
+        args.gateway, args.image_url,
+        retries=args.retries, deadline_ms=args.deadline_ms,
+    )
     print(json.dumps(scores, indent=2))
     return 0
 

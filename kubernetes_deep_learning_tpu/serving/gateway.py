@@ -34,6 +34,15 @@ from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.ops import preprocess
 from kubernetes_deep_learning_tpu.runtime import BatcherClosed, QueueFull
 from kubernetes_deep_learning_tpu.serving import protocol
+from kubernetes_deep_learning_tpu.serving.admission import (
+    DEADLINE_HEADER,
+    AdmissionController,
+    CircuitBreaker,
+    Deadline,
+    Shed,
+    install_sigterm_drain,
+    retry_after_headers,
+)
 from kubernetes_deep_learning_tpu.serving.microbatch import UpstreamStall
 from kubernetes_deep_learning_tpu.serving.tracing import (
     REQUEST_ID_HEADER,
@@ -61,11 +70,18 @@ MAX_PREDICT_BODY_BYTES = 4 * 1024 * 1024  # /predict bodies are JSON of up to
 
 
 class UpstreamError(RuntimeError):
-    """Model-tier failure; surfaces as a retryable 5xx, never a client 400."""
+    """Model-tier failure; surfaces as a retryable 5xx, never a client 400.
 
-    def __init__(self, msg: str, http_status: int = 502):
+    ``retry_after_s`` carries the model tier's own Retry-After hint (or the
+    circuit breaker's remaining cool-down) through to the client response.
+    """
+
+    def __init__(
+        self, msg: str, http_status: int = 502, retry_after_s: float | None = None
+    ):
         super().__init__(msg)
         self.http_status = http_status
+        self.retry_after_s = retry_after_s
 
 
 class Gateway:
@@ -79,6 +95,7 @@ class Gateway:
         request_log: bool = False,
         upstream_batch: int = 0,
         upstream_delay_ms: float = 2.0,
+        admission: bool | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -121,6 +138,16 @@ class Gateway:
         self._m_fetch = self.registry.histogram(
             "kdlt_gateway_fetch_seconds", "image download+decode+resize latency"
         )
+        # Admission control (serving.admission): deadline budgets, AIMD
+        # concurrency limiting, shed accounting, graceful drain -- the
+        # gateway-tier front door.  admission=None -> $KDLT_ADMISSION ->
+        # enabled.  The breaker guards the upstream hop: a dead/saturated
+        # model tier turns into fast local 503s instead of a thread-pinning
+        # timeout per request.
+        self.admission = AdmissionController(
+            self.registry, tier="gateway", enabled=admission
+        )
+        self.breaker = CircuitBreaker()
 
         self._httpd = None
         self.port = port
@@ -178,23 +205,40 @@ class Gateway:
         self._m_fetch.observe(time.perf_counter() - t0)
         return image
 
-    def _predict_batch(self, images, request_id: str = "") -> tuple[list, list[str]]:
+    def _predict_batch(
+        self, images, request_id: str = "", deadline: Deadline | None = None
+    ) -> tuple[list, list[str]]:
         """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
 
         One retry on 503: that status is the model tier's explicit transient
         overload signal (batcher QueueFull), so a brief backoff usually
         succeeds and spares the client a round trip; anything else fails
         straight through.
+
+        Deadline-aware: the read timeout is clamped to the request's
+        remaining budget (a caller that will give up in 800 ms must not
+        hold this thread for 20 s), the REMAINING budget travels upstream
+        in the deadline header, and the circuit breaker refuses the call
+        outright while the model tier is known-unhealthy.
         """
         import requests
 
+        if self.admission.enabled and not self.breaker.allow():
+            self.admission.count_shed("breaker_open")
+            raise UpstreamError(
+                "model tier circuit breaker is open",
+                503,
+                retry_after_s=self.breaker.retry_after_s() or 0.5,
+            )
         body = protocol.encode_predict_request(images)
         # (connect, read) pair: only the READ budget scales with batch size;
         # an unreachable model tier should still fail fast at connect.
-        timeout = (
-            PREDICT_TIMEOUT_S,
-            PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(0, images.shape[0] - 1),
+        read_timeout = (
+            PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(0, images.shape[0] - 1)
         )
+        if deadline is not None:
+            read_timeout = deadline.clamp(read_timeout, floor_s=0.05)
+        timeout = (min(PREDICT_TIMEOUT_S, max(read_timeout, 0.05)), read_timeout)
         r = None
         for attempt in (0, 1):
             if attempt:
@@ -203,6 +247,8 @@ class Gateway:
                 headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
                 if request_id:  # cross-tier trace propagation
                     headers[REQUEST_ID_HEADER] = request_id
+                if deadline is not None:  # remaining budget, re-measured now
+                    headers[DEADLINE_HEADER] = deadline.header_value()
                 r = self._session().post(
                     f"{self._base}/v1/models/{self.model}:predict",
                     data=body,
@@ -210,13 +256,29 @@ class Gateway:
                     timeout=timeout,
                 )
             except requests.RequestException as e:
+                self.breaker.record_failure()
                 raise UpstreamError(f"model server unreachable: {e}") from e
+            # Breaker bookkeeping per attempt: any 5xx (including the
+            # tier's 503 shed) is evidence of an unhealthy/saturated tier;
+            # 2xx-4xx means it is up and judging requests on their merits.
+            if r.status_code >= 500:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
             if r.status_code != 503:
                 break
         if r.status_code != 200:
             status = 503 if r.status_code == 503 else 502
+            retry_after = None
+            if status == 503:
+                try:
+                    retry_after = float(r.headers.get("Retry-After", ""))
+                except (TypeError, ValueError):
+                    retry_after = None
             raise UpstreamError(
-                f"model server error {r.status_code}: {r.text[:200]}", status
+                f"model server error {r.status_code}: {r.text[:200]}",
+                status,
+                retry_after_s=retry_after,
             )
         try:
             logits, labels = protocol.decode_predict_response(
@@ -228,17 +290,28 @@ class Gateway:
             raise UpstreamError(f"malformed model server response: {e}") from e
         return logits, labels
 
-    def apply_model(self, url: str, request_id: str = "") -> dict[str, float]:
+    def apply_model(
+        self, url: str, request_id: str = "", deadline: Deadline | None = None
+    ) -> dict[str, float]:
         """url -> {label: score}; the reference's apply_model
         (reference model_server.py:52-56)."""
         image = self._fetch_one(url)
         if self._microbatcher is not None:
-            row, labels = self._microbatcher.predict(image, request_id)
+            row, labels = self._microbatcher.predict(
+                image,
+                request_id,
+                timeout=None if deadline is None else deadline.remaining_s(),
+            )
             return dict(zip(labels, map(float, row)))
-        logits, labels = self._predict_batch(image[None], request_id)
+        logits, labels = self._predict_batch(image[None], request_id, deadline)
         return dict(zip(labels, map(float, logits[0])))
 
-    def apply_model_batch(self, urls: list[str], request_id: str = "") -> list[dict]:
+    def apply_model_batch(
+        self,
+        urls: list[str],
+        request_id: str = "",
+        deadline: Deadline | None = None,
+    ) -> list[dict]:
         """urls -> per-url {label: score} or {"error": ...}, order-preserving.
 
         Beyond-reference extension: fetches run concurrently (IO-bound) and
@@ -267,7 +340,7 @@ class Gateway:
             import numpy as np
 
             logits, labels = self._predict_batch(
-                np.stack([img for _, img in good]), request_id
+                np.stack([img for _, img in good]), request_id, deadline
             )
             for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
@@ -291,6 +364,10 @@ class Gateway:
         if path == "/healthz":
             return 200, b"ok", "text/plain"
         if path == "/readyz":
+            if self.admission.draining:
+                # Drain flips readiness FIRST so the Service/LB stops
+                # routing here while in-flight work completes.
+                return 503, b"draining", "text/plain"
             try:
                 self.spec  # reachable + spec discoverable => ready
                 return 200, b"ready", "text/plain"
@@ -319,36 +396,57 @@ class Gateway:
         return None
 
     def handle_predict(
-        self, body: bytes, request_id: str | None = None
-    ) -> tuple[int, bytes, str]:
-        """POST /predict body -> (status, body, content_type), instrumented.
+        self,
+        body: bytes,
+        request_id: str | None = None,
+        deadline: Deadline | None = None,
+    ) -> tuple[int, bytes, str, dict[str, str]]:
+        """POST /predict body -> (status, body, content_type, extra_headers).
 
         ``request_id`` is the (already-sanitized) cross-tier trace id; both
         transports mint/sanitize it via tracing.ensure_request_id before
         calling here so the id in the response header, the upstream call,
-        and the log line is the same one.
+        and the log line is the same one.  ``deadline`` is the request's
+        parsed deadline budget (transports build it from the
+        X-Request-Deadline-Ms header when admission is enabled); the extra
+        headers carry Retry-After on shed/overload responses.
         """
         t0 = time.perf_counter()
         rid = request_id or ensure_request_id(None)
         self._m_requests.inc()
         status = 500
         n_urls = 1
+        ticket = None
         try:
+            if deadline is None and self.admission.enabled:
+                deadline = Deadline.default()
+            try:
+                ticket = self.admission.admit(deadline)
+            except Shed as e:
+                self._m_errors.inc()
+                status = e.http_status
+                return status, json.dumps(
+                    {"error": str(e), "shed_reason": e.reason}
+                ).encode(), "application/json", e.headers()
             req = json.loads(body)
             if "urls" in req:  # batch extension; {"url": ...} is the
                 # reference's schema (reference test.py:15) and unchanged
                 urls = list(req["urls"])
                 n_urls = len(urls)
-                preds = self.apply_model_batch(urls, rid)
+                preds = self.apply_model_batch(urls, rid, deadline)
                 status = 200
-                return 200, json.dumps({"predictions": preds}).encode(), "application/json"
-            scores = self.apply_model(req["url"], rid)
+                return 200, json.dumps({"predictions": preds}).encode(), "application/json", {}
+            scores = self.apply_model(req["url"], rid, deadline)
             status = 200
-            return 200, json.dumps(scores).encode(), "application/json"
+            return 200, json.dumps(scores).encode(), "application/json", {}
         except UpstreamError as e:
             self._m_errors.inc()
             status = e.http_status
-            return e.http_status, json.dumps({"error": str(e)}).encode(), "application/json"
+            if ticket is not None and status == 503:
+                ticket.mark_overloaded()  # AIMD: the tier below is saturated
+            return e.http_status, json.dumps(
+                {"error": str(e)}
+            ).encode(), "application/json", retry_after_headers(e.retry_after_s)
         except (QueueFull, BatcherClosed, UpstreamStall) as e:
             # Transient server-side conditions from the upstream
             # micro-batcher (overload, shutdown race, hung upstream): a
@@ -359,18 +457,24 @@ class Gateway:
             # client-side image-fetch timeouts on Python >= 3.11.)
             self._m_errors.inc()
             status = 503
+            if ticket is not None:
+                ticket.mark_overloaded()
             return 503, json.dumps(
                 {"error": f"upstream unavailable: {e}"}
-            ).encode(), "application/json"
+            ).encode(), "application/json", retry_after_headers(0.05)
         except Exception as e:
             # Bad JSON, missing "url", unfetchable/undecodable image:
             # genuinely the caller's fault.
             self._m_errors.inc()
             status = 400
-            return 400, json.dumps({"error": str(e)}).encode(), "application/json"
+            return 400, json.dumps({"error": str(e)}).encode(), "application/json", {}
         finally:
+            if ticket is not None:
+                ticket.release()
             self._m_latency.observe(time.perf_counter() - t0)
-            if self.request_log or status >= 500:
+            # Sheds (503/504) skip the always-log rule: rejection must stay
+            # cheap under overload; kdlt_admission_shed_total counts them.
+            if self.request_log or (status >= 500 and status not in (503, 504)):
                 log_request("gateway predict", rid, status=status, t0=t0, urls=n_urls)
 
     # --- HTTP plumbing ----------------------------------------------------
@@ -384,12 +488,17 @@ class Gateway:
             def log_message(self, fmt, *args):
                 pass
 
-            def _send(self, code: int, body: bytes, ctype: str, rid: str = ""):
+            def _send(
+                self, code: int, body: bytes, ctype: str, rid: str = "",
+                extra: dict[str, str] | None = None,
+            ):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 if rid:
                     self.send_header(REQUEST_ID_HEADER, rid)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -409,7 +518,15 @@ class Gateway:
                     # than let keep-alive parse gigabytes as a next request.
                     self.close_connection = True
                     return self._send(*rejected, rid)
-                self._send(*gw.handle_predict(self.rfile.read(length), rid), rid)
+                deadline = (
+                    Deadline.from_header(self.headers.get(DEADLINE_HEADER))
+                    if gw.admission.enabled
+                    else None
+                )
+                status, out, ctype, extra = gw.handle_predict(
+                    self.rfile.read(length), rid, deadline
+                )
+                self._send(status, out, ctype, rid, extra)
 
         return Handler
 
@@ -424,6 +541,12 @@ class Gateway:
                 target=self._httpd.serve_forever, name="kdlt-gateway", daemon=True
             )
             self._thread.start()
+
+    def begin_drain(self) -> None:
+        """Graceful-drain entry: /readyz goes 503 and admission sheds new
+        work with reason "draining" while in-flight requests complete
+        (admission.wait_idle observes them).  The CLI wires SIGTERM here."""
+        self.admission.begin_drain()
 
     def shutdown(self) -> None:
         if self._microbatcher is not None:
@@ -455,6 +578,12 @@ def main(argv: list[str] | None = None) -> int:
         "predict of up to this size (0 = off, one upstream call per request)",
     )
     p.add_argument("--upstream-delay-ms", type=float, default=2.0)
+    p.add_argument(
+        "--no-admission",
+        action="store_true",
+        help="disable admission control (deadline rejection, AIMD "
+        "concurrency limiting, circuit breaking); graceful drain stays on",
+    )
     args = p.parse_args(argv)
     gw = Gateway(
         serving_host=args.serving_host,
@@ -463,7 +592,11 @@ def main(argv: list[str] | None = None) -> int:
         request_log=not args.no_request_log,
         upstream_batch=args.upstream_batch,
         upstream_delay_ms=args.upstream_delay_ms,
+        admission=False if args.no_admission else None,
     )
+    # SIGTERM -> flip /readyz, shed new work, finish in-flight, then stop;
+    # pairs with the k8s terminationGracePeriodSeconds/preStop settings.
+    install_sigterm_drain(gw.admission, gw.shutdown)
     print(f"gateway listening on :{gw.port}, model tier at {gw.serving_host}")
     gw.start(block=True)
     return 0

@@ -94,8 +94,19 @@ class UpstreamMicroBatcher:
         )
         self._thread.start()
 
-    def predict(self, image: np.ndarray, request_id: str = ""):
-        """One image (H,W,C) -> (logit_row, labels); blocks until served."""
+    def predict(
+        self, image: np.ndarray, request_id: str = "", timeout: float | None = None
+    ):
+        """One image (H,W,C) -> (logit_row, labels); blocks until served.
+
+        ``timeout`` is the caller's REMAINING deadline budget
+        (serving.admission): with only the fixed RESULT_TIMEOUT_S bound, a
+        waiter whose caller timed out at 20 s kept blocking a gateway
+        thread for up to 120 s -- a slow leak under sustained overload.
+        The wait is bounded by min(budget, RESULT_TIMEOUT_S), and a
+        timed-out waiter's entry is discarded from the queue if it has not
+        been flushed yet, so abandoned work never reaches the model tier.
+        """
         from kubernetes_deep_learning_tpu.runtime import BatcherClosed, QueueFull
 
         fut: Future = Future()
@@ -110,12 +121,26 @@ class UpstreamMicroBatcher:
                 )
             self._queue.append((image, request_id, fut))
             self._nonempty.notify()
+        bound = (
+            RESULT_TIMEOUT_S if timeout is None
+            else max(0.0, min(timeout, RESULT_TIMEOUT_S))
+        )
         try:
-            return fut.result(timeout=RESULT_TIMEOUT_S)
+            return fut.result(timeout=bound)
         except FuturesTimeout:
+            self._discard(fut)
             raise UpstreamStall(
-                f"no upstream response in {RESULT_TIMEOUT_S:.0f}s"
+                f"no upstream response in {bound:.1f}s"
             ) from None
+
+    def _discard(self, fut: Future) -> None:
+        """Drop a timed-out waiter's entry if it is still queued (its caller
+        is gone; flushing it upstream would be pure wasted work)."""
+        with self._lock:
+            for i, (_, _, f) in enumerate(self._queue):
+                if f is fut:
+                    del self._queue[i]
+                    return
 
     def _run(self) -> None:
         while True:

@@ -54,6 +54,16 @@ from kubernetes_deep_learning_tpu.runtime import (
     create_batcher,
     resolve_pipeline_depth,
 )
+from kubernetes_deep_learning_tpu.serving.admission import (
+    DEADLINE_HEADER,
+    AdaptiveLimiter,
+    AdmissionController,
+    Deadline,
+    Shed,
+    admission_enabled,
+    install_sigterm_drain,
+    retry_after_headers,
+)
 from kubernetes_deep_learning_tpu.serving.tracing import (
     REQUEST_ID_HEADER,
     ensure_request_id,
@@ -86,6 +96,16 @@ class ServedModel:
         # unloaded (ModelServer.poll_versions).
         self.registry_child = registry.with_labels(
             model=artifact.spec.name, version=str(self.version)
+        )
+        # The deadline budget handed to the batcher/dispatcher wait, in ms:
+        # the last hop of the gateway -> model tier -> batcher propagation
+        # chain, so the chain is observable end to end on /metrics (each
+        # tier's kdlt_admission_deadline_remaining_ms shrinks, then this).
+        self._m_batcher_budget = self.registry_child.histogram(
+            "kdlt_admission_batcher_budget_ms",
+            "remaining deadline budget when the request reached the "
+            "batcher/dispatcher wait",
+            buckets=metrics_lib.DEADLINE_MS_BUCKETS,
         )
         try:
             self.engine = engine_factory(
@@ -125,7 +145,21 @@ class ServedModel:
             registry.remove(self.registry_child)
             raise
 
-    def predict(self, images: np.ndarray) -> np.ndarray:
+    def predict(
+        self, images: np.ndarray, deadline: Deadline | None = None
+    ) -> np.ndarray:
+        # Deadline-aware waits (serving.admission): every blocking wait
+        # below -- the batcher future, the chunked dispatcher futures -- is
+        # bounded by the request's REMAINING budget instead of a fixed
+        # constant, so a request never occupies a handler thread past the
+        # point its caller stopped listening.  deadline=None (admission
+        # off, gRPC path) keeps the legacy fixed bounds.
+        batcher_timeout, future_timeout = 20.0, 120.0
+        if deadline is not None:
+            remaining = max(deadline.remaining_s(), 0.0)
+            self._m_batcher_budget.observe(remaining * 1e3)
+            batcher_timeout = min(batcher_timeout, remaining)
+            future_timeout = min(future_timeout, remaining)
         # Multi-image requests go straight to the engine (they are already a
         # batch); single uint8 images go through the batcher to coalesce
         # across concurrent requests (the batcher is uint8-only so mixed
@@ -136,7 +170,7 @@ class ServedModel:
             and images.dtype == np.uint8
         ):
             try:
-                return self.batcher.predict(images[0])[None]
+                return self.batcher.predict(images[0], timeout=batcher_timeout)[None]
             except BatcherClosed:
                 # A hot reload closed this version's batcher while the
                 # handler already held a reference to it; the engine is
@@ -158,7 +192,9 @@ class ServedModel:
                     self.dispatcher.submit(images[i : i + max_b])
                     for i in range(0, images.shape[0], max_b)
                 ]
-                return np.concatenate([f.result(timeout=120.0) for f in futs])
+                return np.concatenate(
+                    [f.result(timeout=future_timeout) for f in futs]
+                )
             except DispatcherClosed:
                 pass  # hot reload race: fall through to the serial engine path
         return np.concatenate(
@@ -194,6 +230,7 @@ class ModelServer:
         request_log: bool = False,
         engine_factory=None,
         pipeline_depth: int | None = None,
+        admission: bool | None = None,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -223,6 +260,21 @@ class ModelServer:
         )
         self._m_latency = self.registry.histogram(
             "kdlt_server_request_seconds", "request handling latency"
+        )
+        # Admission control (serving.admission): the model tier's front
+        # door -- deadline-exhausted rejection before the TPU is touched,
+        # AIMD concurrency limiting, and graceful drain.  admission=None ->
+        # $KDLT_ADMISSION -> enabled.  The concurrency floor is 2x the max
+        # bucket: the admitted handlers ARE the batcher's supply, so a
+        # lower limit would starve batch formation and DESTROY throughput
+        # (batches of 1) without reducing anyone's latency -- below the
+        # floor, overload belongs to the shed path, not the limiter.
+        self.admission = AdmissionController(
+            self.registry, tier="model-server", enabled=admission,
+            limiter=(
+                AdaptiveLimiter(min_limit=2.0 * max(buckets))
+                if admission_enabled(admission) else None
+            ),
         )
         self.models: dict[str, ServedModel] = {}
         self.model_root = model_root
@@ -365,23 +417,33 @@ class ModelServer:
             def log_message(self, fmt, *args):  # quiet; metrics cover it
                 pass
 
-            def _send(self, code: int, body: bytes, ctype: str = "application/json"):
+            def _send(
+                self, code: int, body: bytes, ctype: str = "application/json",
+                headers: dict[str, str] | None = None,
+            ):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 if getattr(self, "_rid", ""):
                     self.send_header(REQUEST_ID_HEADER, self._rid)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _send_json(self, code: int, obj):
-                self._send(code, json.dumps(obj).encode())
+            def _send_json(self, code: int, obj, headers=None):
+                self._send(code, json.dumps(obj).encode(), headers=headers)
 
             def do_GET(self):
                 self._rid = ""  # keep-alive: never echo a previous POST's id
                 if self.path == "/healthz":
                     return self._send(200, b"ok", "text/plain")
                 if self.path == "/readyz":
+                    if server.admission.draining:
+                        # Drain flips readiness FIRST: the Service endpoint
+                        # pool stops routing here while in-flight batches
+                        # complete (the gateway has the same semantics).
+                        return self._send(503, b"draining", "text/plain")
                     if server.ready:
                         return self._send(200, b"ready", "text/plain")
                     return self._send(503, b"warming up", "text/plain")
@@ -428,7 +490,20 @@ class ModelServer:
                 if model is None:
                     server._m_errors.inc()
                     return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
+                # The propagated deadline budget (gateway or deadline-aware
+                # client); parsed only when admission is on so the disabled
+                # posture is exactly the legacy fixed-timeout behavior.
+                deadline = (
+                    Deadline.from_header(self.headers.get(DEADLINE_HEADER))
+                    if server.admission.enabled
+                    else None
+                )
+                ticket = None
                 try:
+                    # Admission BEFORE the body is read or decoded: an
+                    # exhausted or shed request must cost no decode work and
+                    # never touch the TPU.
+                    ticket = server.admission.admit(deadline)
                     length = int(self.headers.get("Content-Length", 0))
                     spec = model.artifact.spec
                     # Enforce the byte bound BEFORE reading/decoding: a cap
@@ -467,12 +542,20 @@ class ModelServer:
                             f"{MAX_IMAGES_PER_REQUEST}-image request limit"
                         )
                     batch = images.shape[0]
-                    logits = model.predict(images)
+                    logits = model.predict(images, deadline=deadline)
                     out, out_ctype = protocol.encode_predict_response(
                         logits, spec.labels, ctype
                     )
                     status = 200
                     self._send(200, out, out_ctype)
+                except Shed as e:  # admission refusal, not a fault
+                    server._m_errors.inc()
+                    status = e.http_status
+                    self._send_json(
+                        status,
+                        {"error": str(e), "shed_reason": e.reason},
+                        headers=e.headers(),
+                    )
                 except ValueError as e:  # malformed request
                     server._m_errors.inc()
                     status = 400
@@ -480,14 +563,32 @@ class ModelServer:
                 except (QueueFull, FuturesTimeout) as e:  # transient overload
                     server._m_errors.inc()
                     status = 503
-                    self._send_json(503, {"error": f"overloaded: {e or 'timed out'}"})
+                    if ticket is not None:
+                        # AIMD congestion signal: an ADMITTED request still
+                        # missed its budget / found the batcher full, so the
+                        # concurrency limit is too high for current service
+                        # times.
+                        ticket.mark_overloaded()
+                    self._send_json(
+                        503,
+                        {"error": f"overloaded: {e or 'timed out'}"},
+                        headers=retry_after_headers(0.05),
+                    )
                 except Exception as e:  # internal failure
                     server._m_errors.inc()
                     status = 500
                     self._send_json(500, {"error": str(e)})
                 finally:
+                    if ticket is not None:
+                        ticket.release()
                     server._m_latency.observe(time.perf_counter() - t0)
-                    if server.request_log or status >= 500:
+                    # Sheds (503/504) are excluded from the always-log rule:
+                    # rejection must stay cheap under overload (a log line
+                    # per shed IS load), and kdlt_admission_shed_total
+                    # already counts them.  request_log=True still logs all.
+                    if server.request_log or (
+                        status >= 500 and status not in (503, 504)
+                    ):
                         log_request(
                             "model-server predict",
                             rid,
@@ -553,6 +654,12 @@ class ModelServer:
                 target=self._httpd.serve_forever, name="kdlt-model-server", daemon=True
             )
             self._thread.start()
+
+    def begin_drain(self) -> None:
+        """Graceful-drain entry: /readyz goes 503, new predicts shed with
+        reason "draining", in-flight batches run to completion (observable
+        via admission.wait_idle).  The CLI wires SIGTERM here."""
+        self.admission.begin_drain()
 
     def shutdown(self) -> None:
         self._watcher_stop.set()
@@ -773,6 +880,12 @@ def main(argv: list[str] | None = None) -> int:
              "round exceeds this many seconds (dead follower); 0 disables",
     )
     p.add_argument(
+        "--no-admission",
+        action="store_true",
+        help="disable admission control (deadline rejection + AIMD "
+        "concurrency limiting); graceful drain stays on",
+    )
+    p.add_argument(
         "--compile-cache-dir",
         default="",
         help="persistent XLA compilation-cache directory; '' enables it only "
@@ -838,7 +951,11 @@ def main(argv: list[str] | None = None) -> int:
         profile_base=None if args.no_profiling else args.profile_dir,
         request_log=not args.no_request_log,
         pipeline_depth=args.pipeline_depth or None,
+        admission=False if args.no_admission else None,
     )
+    # SIGTERM -> flip /readyz, stop admission, let in-flight batches finish,
+    # then stop; fits inside the k8s terminationGracePeriodSeconds budget.
+    install_sigterm_drain(server.admission, server.shutdown)
     server.warmup()
     if args.watch_interval > 0:
         server.start_version_watcher(args.watch_interval)

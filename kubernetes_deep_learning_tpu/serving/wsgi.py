@@ -38,6 +38,10 @@ class GatewayWSGI:
         self.gateway = gateway or Gateway(bind=False)
 
     def __call__(self, environ: dict, start_response: Callable) -> Iterable[bytes]:
+        from kubernetes_deep_learning_tpu.serving.admission import (
+            WSGI_DEADLINE_KEY,
+            Deadline,
+        )
         from kubernetes_deep_learning_tpu.serving.tracing import (
             REQUEST_ID_HEADER,
             ensure_request_id,
@@ -46,6 +50,7 @@ class GatewayWSGI:
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
         rid = ensure_request_id(environ.get("HTTP_X_REQUEST_ID"))
+        extra: dict[str, str] = {}
         if method == "GET":
             code, body, ctype = self.gateway.handle_get(path)
         elif method == "POST" and path == "/predict":
@@ -55,8 +60,13 @@ class GatewayWSGI:
                 code, body, ctype = rejected  # body stays unread; gunicorn
                 # discards the connection on its own
             else:
-                code, body, ctype = self.gateway.handle_predict(
-                    environ["wsgi.input"].read(length), rid
+                deadline = (
+                    Deadline.from_header(environ.get(WSGI_DEADLINE_KEY))
+                    if self.gateway.admission.enabled
+                    else None
+                )
+                code, body, ctype, extra = self.gateway.handle_predict(
+                    environ["wsgi.input"].read(length), rid, deadline
                 )
         else:
             code, body, ctype = 404, b'{"error": "not found"}', "application/json"
@@ -66,6 +76,7 @@ class GatewayWSGI:
                 ("Content-Type", ctype),
                 ("Content-Length", str(len(body))),
                 (REQUEST_ID_HEADER, rid),
+                *extra.items(),
             ],
         )
         return [body]

@@ -62,6 +62,68 @@ def pipeline_stage_histograms(registry: "Registry") -> dict:
     }
 
 
+# Admission control (serving.admission): every way a tier can refuse work,
+# as the ``shed_reason`` label on kdlt_admission_shed_total.  Shared between
+# both tiers so one dashboard query covers the whole path.
+ADMISSION_SHED_REASONS = (
+    ("deadline_exhausted", "the deadline budget was spent before execution (504)"),
+    ("queue_timeout", "no concurrency slot freed within the bounded queue wait"),
+    ("queue_full", "the admission queue's waiter cap was reached"),
+    ("breaker_open", "the model-tier circuit breaker refused the call"),
+    ("draining", "the tier is draining for shutdown"),
+)
+
+# Deadline budgets are ms-scale; the request-latency buckets (seconds) would
+# collapse every remaining-budget observation into two bins.
+DEADLINE_MS_BUCKETS = (
+    1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+    10_000, 20_000, 60_000, 120_000,
+)
+
+
+def admission_metrics(registry: "Registry") -> dict:
+    """The per-tier admission series (kdlt_admission_*).
+
+    Centralized like pipeline_stage_histograms: the gateway controller, the
+    model-tier controller, and the overload bench all emit the SAME names,
+    distinguished only by the registry's tier label.
+    """
+    return {
+        "requests": registry.counter(
+            "kdlt_admission_requests_total", "requests seen by admission control"
+        ),
+        "admitted": registry.counter(
+            "kdlt_admission_admitted_total", "requests admitted to execution"
+        ),
+        "queue_wait": registry.histogram(
+            "kdlt_admission_queue_wait_seconds",
+            "wait for a concurrency slot before execution",
+            buckets=PIPELINE_STAGE_BUCKETS,
+        ),
+        "deadline_remaining_ms": registry.histogram(
+            "kdlt_admission_deadline_remaining_ms",
+            "remaining deadline budget at admission (propagation evidence: "
+            "each tier down the path observes strictly less)",
+            buckets=DEADLINE_MS_BUCKETS,
+        ),
+        "limit": registry.gauge(
+            "kdlt_admission_concurrency_limit", "current AIMD concurrency limit"
+        ),
+        "inflight": registry.gauge(
+            "kdlt_admission_inflight", "admitted requests currently executing"
+        ),
+        "draining": registry.gauge(
+            "kdlt_admission_draining", "1 while the tier refuses new work for shutdown"
+        ),
+        "shed": {
+            reason: registry.with_labels(shed_reason=reason).counter(
+                "kdlt_admission_shed_total", help
+            )
+            for reason, help in ADMISSION_SHED_REASONS
+        },
+    }
+
+
 def _fmt_labels(labels: dict[str, str] | None, extra: str = "") -> str:
     parts = [f'{k}="{v}"' for k, v in (labels or {}).items()]
     if extra:

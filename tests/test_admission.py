@@ -1,0 +1,250 @@
+"""Unit tests for the admission-control primitives (serving.admission).
+
+Deadline parsing/clamping, the AIMD limiter's bounds and adaptation, the
+circuit breaker's closed/open/half-open machine (fake clock, no sleeps),
+and the controller's admit/shed/drain bookkeeping -- all device-free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_deep_learning_tpu.serving.admission import (
+    AdaptiveLimiter,
+    AdmissionController,
+    CircuitBreaker,
+    Deadline,
+    Shed,
+)
+from kubernetes_deep_learning_tpu.serving.admission import breaker as breaker_mod
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+
+# --- Deadline --------------------------------------------------------------
+
+
+def test_deadline_header_parse_default_and_garbage(monkeypatch):
+    monkeypatch.delenv("KDLT_ADMISSION_DEFAULT_DEADLINE_MS", raising=False)
+    for raw in (None, "", "  ", "not-a-number"):
+        d = Deadline.from_header(raw)
+        assert d.budget_s == pytest.approx(20.0)  # the reference's 20 s
+        assert not d.expired
+    monkeypatch.setenv("KDLT_ADMISSION_DEFAULT_DEADLINE_MS", "5000")
+    assert Deadline.from_header(None).budget_s == pytest.approx(5.0)
+
+
+def test_deadline_header_clamp_and_exhaustion():
+    # Oversized budgets are capped; non-positive ones arrive pre-exhausted.
+    d = Deadline.from_header("999999999")
+    assert d.budget_s <= 300.0
+    for raw in ("0", "-50"):
+        d = Deadline.from_header(raw)
+        assert d.expired
+    d = Deadline.from_header("250")
+    assert 0.2 < d.remaining_s() <= 0.25
+    assert float(d.header_value()) <= 250.0
+
+
+def test_deadline_clamp_shrinks_timeouts():
+    d = Deadline(0.1)
+    assert d.clamp(20.0) <= 0.1
+    assert Deadline(50.0).clamp(20.0) == 20.0
+    # An expired deadline clamps to the floor, never to a non-positive
+    # socket timeout (which would mean "wait forever").
+    assert Deadline(-1.0).clamp(20.0, floor_s=0.05) == 0.05
+
+
+# --- AdaptiveLimiter -------------------------------------------------------
+
+
+def test_limiter_concurrency_bound_and_queue_full():
+    lim = AdaptiveLimiter(min_limit=1, max_limit=2, initial=2, queue_cap=1,
+                          max_queue_wait_s=0.05)
+    assert lim.acquire() == 0.0
+    assert lim.acquire() == 0.0
+    # Third request queues; fourth overflows the 1-waiter cap immediately.
+    t = threading.Thread(target=lambda: pytest.raises(Shed, lim.acquire))
+    t.start()
+    time.sleep(0.01)
+    with pytest.raises(Shed) as exc:
+        lim.acquire()
+    assert exc.value.reason == "queue_full"
+    assert exc.value.retry_after_s > 0
+    t.join()
+
+
+def test_limiter_queue_timeout_is_budget_fraction_bounded():
+    lim = AdaptiveLimiter(min_limit=1, max_limit=1, initial=1, queue_cap=8)
+    lim.acquire()
+    t0 = time.monotonic()
+    with pytest.raises(Shed) as exc:
+        lim.acquire(budget_s=0.2)  # bounded at fraction 0.25 -> 50ms
+    waited = time.monotonic() - t0
+    assert exc.value.reason == "queue_timeout"
+    assert waited < 0.15  # far less than the 200ms budget
+
+
+def test_limiter_aimd_decrease_and_hold_and_increase():
+    lim = AdaptiveLimiter(min_limit=1, max_limit=64, initial=8, cooldown_s=0.0)
+    lim.acquire()
+    lim.release(overloaded=True)  # multiplicative decrease
+    assert lim.limit == pytest.approx(8 * 0.9)
+    before = lim.limit
+    lim.acquire()
+    lim.release(headroom=False)  # hold band: neither grow nor shrink
+    assert lim.limit == before
+    lim.acquire()
+    lim.release()  # clean + headroom: additive increase
+    assert lim.limit == pytest.approx(before + 1.0 / before)
+    # The floor holds under repeated congestion.
+    for _ in range(100):
+        lim.acquire()
+        lim.release(overloaded=True)
+    assert lim.limit == 1.0
+
+
+def test_limiter_release_wakes_waiter():
+    lim = AdaptiveLimiter(min_limit=1, max_limit=1, initial=1, queue_cap=4,
+                          max_queue_wait_s=5.0)
+    lim.acquire()
+    waited = []
+
+    def waiter():
+        waited.append(lim.acquire())
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    lim.release()
+    t.join(timeout=5)
+    assert waited and 0.0 < waited[0] < 5.0
+
+
+# --- CircuitBreaker --------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_full_transition_cycle():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=3, reset_timeout_s=2.0,
+                       half_open_probes=1, clock=clock)
+    assert b.state == breaker_mod.CLOSED
+    # Non-consecutive failures never trip.
+    b.record_failure()
+    b.record_failure()
+    b.record_success()
+    b.record_failure()
+    assert b.state == breaker_mod.CLOSED
+    # Three consecutive -> OPEN; everything refused with a cool-down hint.
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == breaker_mod.OPEN
+    assert not b.allow()
+    assert 0 < b.retry_after_s() <= 2.0
+    # Cool-down elapsed -> HALF_OPEN: exactly one probe passes.
+    clock.t = 2.5
+    assert b.allow()
+    assert b.state == breaker_mod.HALF_OPEN
+    assert not b.allow()  # probe slot consumed; others shed
+    # Probe success closes; traffic flows again.
+    b.record_success()
+    assert b.state == breaker_mod.CLOSED
+    assert b.allow()
+
+
+def test_breaker_probe_failure_reopens():
+    clock = FakeClock()
+    b = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                       half_open_probes=1, clock=clock)
+    b.record_failure()
+    assert b.state == breaker_mod.OPEN
+    clock.t = 1.5
+    assert b.allow()
+    b.record_failure()  # the probe failed: straight back to OPEN
+    assert b.state == breaker_mod.OPEN
+    assert not b.allow()
+    assert b.retry_after_s() == pytest.approx(1.0)
+
+
+# --- AdmissionController ---------------------------------------------------
+
+
+def test_controller_admits_and_tracks_inflight():
+    reg = metrics_lib.Registry()
+    ctl = AdmissionController(reg, tier="test", enabled=True)
+    t1 = ctl.admit(Deadline(5.0))
+    t2 = ctl.admit(Deadline(5.0))
+    assert ctl.inflight == 2
+    t1.release()
+    t2.release()
+    t2.release()  # idempotent
+    assert ctl.inflight == 0
+    assert ctl.wait_idle(timeout_s=0.1)
+    rendered = reg.render()
+    assert 'kdlt_admission_requests_total{tier="test"} 2' in rendered
+    assert 'kdlt_admission_admitted_total{tier="test"} 2' in rendered
+
+
+def test_controller_rejects_exhausted_deadline():
+    reg = metrics_lib.Registry()
+    ctl = AdmissionController(reg, tier="test", enabled=True)
+    with pytest.raises(Shed) as exc:
+        ctl.admit(Deadline(-0.01))
+    assert exc.value.reason == "deadline_exhausted"
+    assert exc.value.http_status == 504
+    assert (
+        'kdlt_admission_shed_total{tier="test",shed_reason="deadline_exhausted"} 1'
+        in reg.render()
+    )
+
+
+def test_controller_disabled_tracks_but_never_sheds():
+    reg = metrics_lib.Registry()
+    ctl = AdmissionController(reg, tier="test", enabled=False)
+    # Exhausted deadline, absurd concurrency: all admitted when disabled.
+    tickets = [ctl.admit(Deadline(-1.0)) for _ in range(300)]
+    assert ctl.inflight == 300
+    for t in tickets:
+        t.release()
+    assert ctl.inflight == 0
+
+
+def test_controller_drain_sheds_and_waits_for_inflight():
+    reg = metrics_lib.Registry()
+    ctl = AdmissionController(reg, tier="test", enabled=True)
+    ticket = ctl.admit(Deadline(5.0))
+    ctl.begin_drain()
+    assert ctl.draining
+    with pytest.raises(Shed) as exc:
+        ctl.admit(Deadline(5.0))
+    assert exc.value.reason == "draining"
+    assert exc.value.retry_after_s is not None
+    assert not ctl.wait_idle(timeout_s=0.05)  # still one in flight
+    threading.Timer(0.05, ticket.release).start()
+    assert ctl.wait_idle(timeout_s=5.0)
+    assert 'kdlt_admission_draining{tier="test"} 1.0' in reg.render()
+
+
+def test_admission_env_gate(monkeypatch):
+    from kubernetes_deep_learning_tpu.serving.admission import admission_enabled
+
+    monkeypatch.delenv("KDLT_ADMISSION", raising=False)
+    assert admission_enabled() is True
+    for off in ("0", "false", "off", "no"):
+        monkeypatch.setenv("KDLT_ADMISSION", off)
+        assert admission_enabled() is False
+    monkeypatch.setenv("KDLT_ADMISSION", "1")
+    assert admission_enabled() is True
+    # Explicit argument always wins over the environment.
+    monkeypatch.setenv("KDLT_ADMISSION", "0")
+    assert admission_enabled(True) is True

@@ -1,0 +1,340 @@
+"""Admission control wired through the REAL serving tiers (stub backend).
+
+The acceptance surface of the admission subsystem, all device-free:
+deadline-exhausted rejection at both tiers, shed-vs-accept under a
+saturated stub engine, the gateway circuit breaker's open/half-open/close
+transitions, graceful drain completing in-flight work, and the deadline
+budget observably propagating gateway -> model tier -> batcher via the
+kdlt_admission_* metrics.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from functools import partial
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+from kubernetes_deep_learning_tpu.serving import protocol
+from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+
+def _metric(text: str, name: str, **labels: str) -> float:
+    """First sample of ``name`` whose label set includes ``labels``."""
+    for m in re.finditer(rf"^{re.escape(name)}(\{{[^}}]*\}})? (\S+)$", text, re.M):
+        got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1) or ""))
+        if all(got.get(k) == v for k, v in labels.items()):
+            return float(m.group(2))
+    raise AssertionError(f"no sample {name} with {labels} in:\n{text}")
+
+
+def _make_stub_server(name: str, tmp_path, device_ms: float = 0.0, **kw):
+    spec = register_spec(
+        ModelSpec(
+            name=name,
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    root = tmp_path / "models"
+    art.save_artifact(
+        art.version_dir(str(root), spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        str(root), port=0, buckets=kw.pop("buckets", (1, 2, 4, 8)),
+        max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=lambda a, **ekw: StubEngine(
+            a, device_ms_per_batch=device_ms, **ekw
+        ),
+        **kw,
+    )
+    server.warmup()
+    server.start()
+    return spec, server
+
+
+def _post_predict(spec, server, deadline_ms=None, n=1, timeout=30.0):
+    import requests
+
+    img = np.zeros((n, *spec.input_shape), np.uint8)
+    headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
+    if deadline_ms is not None:
+        headers[DEADLINE_HEADER] = str(deadline_ms)
+    return requests.post(
+        f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict",
+        data=protocol.encode_predict_request(img),
+        headers=headers,
+        timeout=timeout,
+    )
+
+
+# --- deadline-exhausted rejection at both tiers ----------------------------
+
+
+def test_model_tier_rejects_exhausted_deadline(tmp_path):
+    spec, server = _make_stub_server("adm-exhaust", tmp_path)
+    try:
+        r = _post_predict(spec, server, deadline_ms=0)
+        assert r.status_code == 504
+        assert r.json()["shed_reason"] == "deadline_exhausted"
+        # Rejected BEFORE the engine: no image was executed.
+        import requests
+
+        metrics = requests.get(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).text
+        assert _metric(
+            metrics, "kdlt_admission_shed_total",
+            tier="model-server", shed_reason="deadline_exhausted",
+        ) == 1.0
+        assert _metric(metrics, "kdlt_engine_images_total") == 0.0
+        # A healthy budget on the same server still serves.
+        assert _post_predict(spec, server, deadline_ms=10_000).status_code == 200
+    finally:
+        server.shutdown()
+
+
+def test_gateway_rejects_exhausted_deadline_without_upstream_call(tmp_path):
+    import requests
+
+    # Upstream host is a dead port: if the gateway consulted the model tier
+    # at all this would be a 502, not the admission 504.
+    gw = Gateway(serving_host="127.0.0.1:9", model="nope", port=0, host="127.0.0.1")
+    gw.start()
+    try:
+        r = requests.post(
+            f"http://127.0.0.1:{gw.port}/predict",
+            json={"url": "http://127.0.0.1:1/x.png"},
+            headers={DEADLINE_HEADER: "0"},
+            timeout=10,
+        )
+        assert r.status_code == 504
+        assert r.json()["shed_reason"] == "deadline_exhausted"
+    finally:
+        gw.shutdown()
+
+
+# --- shed vs accept under a saturated stub engine --------------------------
+
+
+def test_saturated_stub_sheds_excess_and_serves_the_rest(tmp_path, monkeypatch):
+    # 2 concurrency slots (floor = 2 x max bucket), 150 ms serial service:
+    # 8 concurrent requests with a 1 s budget cannot all fit -- the excess
+    # must shed with a Retry-After while the admitted ones complete.
+    monkeypatch.setenv("KDLT_ADMISSION_MAX_CONCURRENCY", "2")
+    monkeypatch.setenv("KDLT_ADMISSION_INITIAL_CONCURRENCY", "2")
+    spec, server = _make_stub_server(
+        "adm-saturated", tmp_path, device_ms=150.0, buckets=(1,)
+    )
+    try:
+        results: list = [None] * 8
+
+        def hit(i):
+            results[i] = _post_predict(spec, server, deadline_ms=1000)
+
+        threads = [threading.Thread(target=hit, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        statuses = [r.status_code for r in results]
+        assert statuses.count(200) >= 1, statuses
+        shed = [r for r in results if r.status_code in (503, 504)]
+        assert shed, statuses
+        for r in shed:
+            assert "Retry-After" in r.headers or "shed_reason" in r.json()
+        import requests
+
+        metrics = requests.get(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).text
+        total_shed = sum(
+            _metric(metrics, "kdlt_admission_shed_total",
+                    tier="model-server", shed_reason=reason)
+            for reason in ("queue_timeout", "queue_full", "deadline_exhausted")
+        )
+        admitted = _metric(
+            metrics, "kdlt_admission_admitted_total", tier="model-server"
+        )
+        assert admitted >= 1
+        assert total_shed + admitted >= 8 - statuses.count(-1)
+    finally:
+        server.shutdown()
+
+
+# --- circuit breaker transitions through the gateway -----------------------
+
+
+def test_gateway_breaker_open_half_open_close(tmp_path):
+    import requests as requests_lib
+
+    from kubernetes_deep_learning_tpu.serving.admission import CircuitBreaker
+    from kubernetes_deep_learning_tpu.serving.admission import breaker as bmod
+    from kubernetes_deep_learning_tpu.serving.gateway import UpstreamError
+
+    class FakeClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    gw = Gateway(serving_host="127.0.0.1:9", model="m", port=0, bind=False)
+    gw.breaker = CircuitBreaker(
+        failure_threshold=2, reset_timeout_s=5.0, half_open_probes=1, clock=clock
+    )
+    calls = {"n": 0}
+
+    def dead_post(*a, **kw):
+        calls["n"] += 1
+        raise requests_lib.ConnectionError("down")
+
+    session = gw._session()
+    session.post = dead_post
+    img = np.zeros((1, 32, 32, 3), np.uint8)
+    # Two consecutive upstream failures trip the breaker (a connection
+    # error fails straight through, one recorded failure per call).
+    for _ in range(2):
+        with pytest.raises(UpstreamError):
+            gw._predict_batch(img)
+    assert gw.breaker.state == bmod.OPEN
+    # OPEN: refused locally, upstream never dialed, Retry-After = cool-down.
+    before = calls["n"]
+    with pytest.raises(UpstreamError) as exc:
+        gw._predict_batch(img)
+    assert "breaker" in str(exc.value)
+    assert exc.value.http_status == 503
+    assert exc.value.retry_after_s == pytest.approx(5.0)
+    assert calls["n"] == before
+
+    # Cool-down elapsed -> HALF_OPEN: the probe goes through to a healthy
+    # upstream and closes the breaker.
+    clock.t = 6.0
+    rows = np.arange(3, dtype=np.float32)[None]
+
+    class Ok:
+        status_code = 200
+        content, headers_ct = protocol.encode_predict_response(
+            rows, ("a", "b", "c"), protocol.MSGPACK_CONTENT_TYPE
+        )
+        headers = {"Content-Type": headers_ct}
+        text = ""
+
+    session.post = lambda *a, **kw: Ok()
+    logits, labels = gw._predict_batch(img)
+    assert gw.breaker.state == bmod.CLOSED
+    assert list(labels) == ["a", "b", "c"]
+    # And the shed was accounted.
+    assert (
+        'kdlt_admission_shed_total{tier="gateway",shed_reason="breaker_open"} 1'
+        in gw.registry.render()
+    )
+
+
+# --- graceful drain ---------------------------------------------------------
+
+
+def test_drain_flips_readyz_sheds_new_work_and_completes_inflight(tmp_path):
+    import requests
+
+    spec, server = _make_stub_server("adm-drain", tmp_path, device_ms=400.0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        assert requests.get(f"{base}/readyz", timeout=5).text == "ready"
+        inflight_result: list = []
+
+        def inflight():
+            inflight_result.append(_post_predict(spec, server, deadline_ms=10_000))
+
+        t = threading.Thread(target=inflight)
+        t.start()
+        # Wait until the request is admitted (in flight), then drain.
+        for _ in range(100):
+            if server.admission.inflight > 0:
+                break
+            threading.Event().wait(0.01)
+        assert server.admission.inflight > 0
+        server.begin_drain()
+        r = requests.get(f"{base}/readyz", timeout=5)
+        assert r.status_code == 503 and r.text == "draining"
+        r = _post_predict(spec, server, deadline_ms=10_000)
+        assert r.status_code == 503
+        assert r.json()["shed_reason"] == "draining"
+        assert "Retry-After" in r.headers
+        # The in-flight request still completes successfully.
+        assert server.admission.wait_idle(timeout_s=10.0)
+        t.join(timeout=10)
+        assert inflight_result and inflight_result[0].status_code == 200
+    finally:
+        server.shutdown()
+
+
+# --- deadline propagation gateway -> model tier -> batcher ------------------
+
+
+def test_deadline_budget_propagates_across_tiers(tmp_path):
+    import requests
+    from PIL import Image
+
+    spec, server = _make_stub_server("adm-propagate", tmp_path)
+    rng = np.random.default_rng(0)
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(tmp_path / "img.png")
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0),
+        partial(SimpleHTTPRequestHandler, directory=str(tmp_path)),
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, host="127.0.0.1",
+    )
+    gw.start()
+    try:
+        r = requests.post(
+            f"http://127.0.0.1:{gw.port}/predict",
+            json={"url": f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"},
+            headers={DEADLINE_HEADER: "5000"},
+            timeout=30,
+        )
+        assert r.status_code == 200, r.text
+        gw_metrics = requests.get(f"http://127.0.0.1:{gw.port}/metrics", timeout=5).text
+        sv_metrics = requests.get(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).text
+        # One observation per stage, each strictly later on the same clock,
+        # so each tier down the path saw strictly less remaining budget.
+        assert _metric(
+            gw_metrics, "kdlt_admission_deadline_remaining_ms_count", tier="gateway"
+        ) == 1.0
+        assert _metric(
+            sv_metrics, "kdlt_admission_deadline_remaining_ms_count",
+            tier="model-server",
+        ) == 1.0
+        assert _metric(sv_metrics, "kdlt_admission_batcher_budget_ms_count") == 1.0
+        at_gateway = _metric(
+            gw_metrics, "kdlt_admission_deadline_remaining_ms_sum", tier="gateway"
+        )
+        at_server = _metric(
+            sv_metrics, "kdlt_admission_deadline_remaining_ms_sum",
+            tier="model-server",
+        )
+        at_batcher = _metric(sv_metrics, "kdlt_admission_batcher_budget_ms_sum")
+        assert 0.0 < at_gateway <= 5000.0
+        assert 0.0 < at_batcher < at_server < at_gateway, (
+            at_gateway, at_server, at_batcher,
+        )
+    finally:
+        gw.shutdown()
+        server.shutdown()
+        img_httpd.shutdown()

@@ -126,6 +126,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--pipeline-ab", "10"], "pipeline_ab"),
         (["--host-saturation", "5"], "host_saturation"),
         (["--batcher-sweep", "5"], "batcher_sweep"),
+        (["--overload-ab", "6"], "overload_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -134,6 +135,28 @@ def test_dry_run_covers_the_auxiliary_modes():
         assert proc.returncode == 0
         out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
         assert out["mode"] == mode, flags
+
+
+# --- admission-control overload A/B: CLI surface smoke --------------------
+
+
+def test_dry_run_overload_ab_echoes_the_admission_config():
+    # The --overload-ab invocation surface (serving.admission's acceptance
+    # harness) must keep parsing and echo its resolved knobs without
+    # importing jax, binding ports, or spawning servers.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--overload-ab", "6", "--dry-run",
+         "--overload-deadline-ms", "450", "--overload-rate-x", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "overload_ab"
+    assert out["overload"]["deadline_ms"] == 450.0
+    assert out["overload"]["rate_x"] == 3.0
+    assert out["overload"]["buckets"] == [1, 2]
+    assert out["overload"]["device_ms"] == 100.0
 
 
 # --- the pipelined-vs-serial A/B acceptance bound -------------------------

@@ -122,6 +122,42 @@ def test_k8s_model_server_compile_cache_volume():
     assert container["startupProbe"]["failureThreshold"] >= 60
 
 
+def test_k8s_and_compose_drain_semantics():
+    """The graceful-drain wiring (serving.admission): SIGTERM-driven drain
+    needs (a) a preStop sleep so the endpoint controller removes the pod
+    from the Service BEFORE admission stops, and (b) a termination grace
+    period that covers preStop + the KDLT_DRAIN_TIMEOUT_S default (25 s) --
+    otherwise kubelet SIGKILLs mid-drain and in-flight batches die anyway."""
+    from kubernetes_deep_learning_tpu.serving.admission.controller import (
+        DEFAULT_DRAIN_TIMEOUT_S,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    for fname in ("gateway-deployment.yaml", "model-server-deployment.yaml"):
+        (dep,) = _yaml_docs(os.path.join(k8s, fname))
+        pod = dep["spec"]["template"]["spec"]
+        container = pod["containers"][0]
+        grace = pod.get("terminationGracePeriodSeconds", 30)
+        pre_stop = container.get("lifecycle", {}).get("preStop")
+        assert pre_stop is not None, f"{fname}: no preStop hook"
+        sleep_s = float(pre_stop["exec"]["command"][-1])
+        assert grace >= sleep_s + DEFAULT_DRAIN_TIMEOUT_S, (
+            f"{fname}: grace {grace}s cannot cover preStop {sleep_s}s + "
+            f"drain {DEFAULT_DRAIN_TIMEOUT_S}s"
+        )
+        # Drain flips /readyz, so readiness MUST probe /readyz for the
+        # endpoint eviction half of the story to exist at all.
+        assert container["readinessProbe"]["httpGet"]["path"] == "/readyz", fname
+
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    for name, svc in compose["services"].items():
+        grace = svc.get("stop_grace_period", "10s")
+        assert float(str(grace).rstrip("s")) >= DEFAULT_DRAIN_TIMEOUT_S, (
+            f"compose service {name}: stop_grace_period {grace} cannot cover "
+            f"the {DEFAULT_DRAIN_TIMEOUT_S}s drain budget"
+        )
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():
