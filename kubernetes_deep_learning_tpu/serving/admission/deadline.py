@@ -21,6 +21,7 @@ resources for an hour.
 
 from __future__ import annotations
 
+import math
 import os
 import time
 
@@ -71,6 +72,11 @@ class Deadline:
         try:
             ms = float(raw)
         except (TypeError, ValueError):
+            return cls.default()
+        if not math.isfinite(ms):
+            # "nan" parses but slides through min()/max() unchanged -- a
+            # never-expiring deadline that defeats the hostile-header cap
+            # and re-propagates as "nan" downstream.  Garbage -> default.
             return cls.default()
         ms = min(ms, _env_ms(MAX_DEADLINE_MS_ENV, MAX_DEADLINE_MS))
         return cls(max(ms, 0.0) / 1e3)

@@ -51,6 +51,13 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def env_max_limit(default: float = 64.0) -> float:
+    """The operator's concurrency-ceiling override, for callers that
+    reconcile it with a tier-specific floor before constructing the
+    limiter (e.g. the model server's batch-formation floor)."""
+    return _env_float(MAX_CONCURRENCY_ENV, default)
+
+
 class AdaptiveLimiter:
     def __init__(
         self,
@@ -70,6 +77,14 @@ class AdaptiveLimiter:
         self.max_limit = max_limit if max_limit is not None else _env_float(
             MAX_CONCURRENCY_ENV, 64.0
         )
+        # An inverted pair (floor above ceiling, e.g. a tier's explicit
+        # batch-formation floor vs the default env ceiling) must never
+        # reach the AIMD update: release() would clamp decreases UP to
+        # min_limit -- raising admitted concurrency on congestion -- while
+        # acquire() clamps the working limit down to max_limit, oscillating
+        # between the two.  The explicit floor wins.
+        self.max_limit = max(self.max_limit, self.min_limit)
+        assert self.min_limit <= self.max_limit
         self._limit = float(
             initial if initial is not None
             else _env_float(INITIAL_CONCURRENCY_ENV, 8.0)
@@ -141,6 +156,12 @@ class AdaptiveLimiter:
                 while self._slots_full():
                     remaining = giveup - time.monotonic()
                     if remaining <= 0:
+                        # release() hands out a SINGLE notify; if it landed
+                        # on this waiter just as the bound expired, pass it
+                        # on -- otherwise the freed slot idles while the
+                        # remaining waiters sleep out their full bound and
+                        # shed despite available capacity.
+                        self._cond.notify()
                         raise Shed(
                             "queue_timeout",
                             retry_after_s=max(self.target_wait_s, 0.05),

@@ -61,6 +61,9 @@ PER_IMAGE_TIMEOUT_S = 0.25   # extra upstream budget per batched image: a
                              # 256-image predict is one POST and must not be
                              # held to the single-image 20 s deadline
 UPSTREAM_RETRY_BACKOFF_S = 0.05  # one retry on the model tier's 503 overload
+MIN_RETRY_BUDGET_S = 0.05    # a 503 retry must leave at least this much
+                             # deadline budget AFTER the backoff sleep, or
+                             # the retry is skipped (it cannot finish anyway)
 MAX_BATCH_FETCHERS = 8       # concurrent image downloads per batch request
 MAX_URLS_PER_REQUEST = 256   # hard cap: bounds per-request image memory
 MAX_PREDICT_BODY_BYTES = 4 * 1024 * 1024  # /predict bodies are JSON of up to
@@ -243,6 +246,11 @@ class Gateway:
         for attempt in (0, 1):
             if attempt:
                 time.sleep(UPSTREAM_RETRY_BACKOFF_S)
+                if deadline is not None:
+                    # The backoff spent budget; the retry's read must not
+                    # outlive what is left.
+                    read_timeout = deadline.clamp(read_timeout, floor_s=0.05)
+                    timeout = (timeout[0], read_timeout)
             try:
                 headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
                 if request_id:  # cross-tier trace propagation
@@ -266,6 +274,13 @@ class Gateway:
             else:
                 self.breaker.record_success()
             if r.status_code != 503:
+                break
+            if deadline is not None and deadline.remaining_s() < (
+                UPSTREAM_RETRY_BACKOFF_S + MIN_RETRY_BUDGET_S
+            ):
+                # A nearly-expired request must not burn its last budget
+                # sleeping out the backoff and re-posting work that cannot
+                # finish in time; surface the 503 to the client now.
                 break
         if r.status_code != 200:
             status = 503 if r.status_code == 503 else 502

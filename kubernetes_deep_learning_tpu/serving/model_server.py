@@ -64,6 +64,7 @@ from kubernetes_deep_learning_tpu.serving.admission import (
     install_sigterm_drain,
     retry_after_headers,
 )
+from kubernetes_deep_learning_tpu.serving.admission import limiter as limiter_mod
 from kubernetes_deep_learning_tpu.serving.tracing import (
     REQUEST_ID_HEADER,
     ensure_request_id,
@@ -268,11 +269,19 @@ class ModelServer:
         # bucket: the admitted handlers ARE the batcher's supply, so a
         # lower limit would starve batch formation and DESTROY throughput
         # (batches of 1) without reducing anyone's latency -- below the
-        # floor, overload belongs to the shed path, not the limiter.
+        # floor, overload belongs to the shed path, not the limiter.  The
+        # ceiling is reconciled with that floor (2x headroom, or the env
+        # override if higher): the env default (64) sits BELOW the default
+        # buckets' floor (256), and an inverted pair would turn the AIMD
+        # decrease into an increase.
+        floor = 2.0 * max(buckets)
         self.admission = AdmissionController(
             self.registry, tier="model-server", enabled=admission,
             limiter=(
-                AdaptiveLimiter(min_limit=2.0 * max(buckets))
+                AdaptiveLimiter(
+                    min_limit=floor,
+                    max_limit=max(2.0 * floor, limiter_mod.env_max_limit()),
+                )
                 if admission_enabled(admission) else None
             ),
         )
@@ -424,6 +433,11 @@ class ModelServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if self.close_connection:
+                    # Make the closure explicit so a pooling client
+                    # (the gateway's requests.Session) retires the
+                    # connection instead of reusing a dead socket.
+                    self.send_header("Connection", "close")
                 if getattr(self, "_rid", ""):
                     self.send_header(REQUEST_ID_HEADER, self._rid)
                 for k, v in (headers or {}).items():
@@ -433,6 +447,45 @@ class ModelServer:
 
             def _send_json(self, code: int, obj, headers=None):
                 self._send(code, json.dumps(obj).encode(), headers=headers)
+
+            # Bodies at most this size are drained (not closed over) when a
+            # response goes out before the body was read: sheds happen
+            # under overload, exactly when the gateway's pooled keep-alive
+            # connections are most valuable.
+            _DRAIN_LIMIT = 1 << 20
+
+            def _discard_body(self):
+                """Settle an unread request body before connection reuse.
+
+                A response sent before the body is read (shed, 404) leaves
+                the payload in the socket; the keep-alive handler loop
+                would parse it as the next request line, desyncing the
+                gateway's pooled connection and failing innocent follow-on
+                requests with garbage 400s.  Drain small bodies to keep
+                the connection poolable; close on large or unsized ones.
+                """
+                if getattr(self, "_body_consumed", True):
+                    return
+                self._body_consumed = True
+                if "chunked" in self.headers.get("Transfer-Encoding", "").lower():
+                    self.close_connection = True
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                except (TypeError, ValueError):
+                    length = -1
+                if not 0 <= length <= self._DRAIN_LIMIT:
+                    self.close_connection = True
+                    return
+                try:
+                    while length > 0:
+                        chunk = self.rfile.read(min(length, 65536))
+                        if not chunk:
+                            self.close_connection = True
+                            return
+                        length -= len(chunk)
+                except OSError:
+                    self.close_connection = True
 
             def do_GET(self):
                 self._rid = ""  # keep-alive: never echo a previous POST's id
@@ -481,14 +534,17 @@ class ModelServer:
                 self._rid = rid
                 status = 500
                 batch = 0
+                self._body_consumed = False
                 server._m_requests.inc()
                 m = _PREDICT_RE.match(self.path)
                 if not m:
                     server._m_errors.inc()
+                    self._discard_body()
                     return self._send_json(404, {"error": "not found"})
                 model = server.models.get(m.group(1))
                 if model is None:
                     server._m_errors.inc()
+                    self._discard_body()
                     return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
                 # The propagated deadline budget (gateway or deadline-aware
                 # client); parsed only when admission is on so the disabled
@@ -528,6 +584,7 @@ class ModelServer:
                             f"({MAX_IMAGES_PER_REQUEST}-image cap)"
                         )
                     body = self.rfile.read(length)
+                    self._body_consumed = True
                     ctype = self.headers.get("Content-Type", "")
                     images = protocol.decode_predict_request(body, ctype)
                     if images.ndim == 3:
@@ -551,6 +608,10 @@ class ModelServer:
                 except Shed as e:  # admission refusal, not a fault
                     server._m_errors.inc()
                     status = e.http_status
+                    # admit() sheds BEFORE the body is read: settle it now
+                    # so the response can announce Connection: close when
+                    # the body was too large to drain.
+                    self._discard_body()
                     self._send_json(
                         status,
                         {"error": str(e), "shed_reason": e.reason},
@@ -579,6 +640,10 @@ class ModelServer:
                     status = 500
                     self._send_json(500, {"error": str(e)})
                 finally:
+                    # Covers every pre-body-read error response (the Shed
+                    # path foremost: admit() runs before the read); no-op
+                    # once the body was consumed.
+                    self._discard_body()
                     if ticket is not None:
                         ticket.release()
                     server._m_latency.observe(time.perf_counter() - t0)

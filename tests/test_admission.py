@@ -48,6 +48,17 @@ def test_deadline_header_clamp_and_exhaustion():
     assert float(d.header_value()) <= 250.0
 
 
+def test_deadline_header_rejects_non_finite(monkeypatch):
+    # float("nan") parses but slides through the min()/max() cap unchanged:
+    # a never-expiring deadline that would defeat MAX_DEADLINE_MS and
+    # re-propagate as "nan" downstream.  Non-finite -> the default budget.
+    monkeypatch.delenv("KDLT_ADMISSION_DEFAULT_DEADLINE_MS", raising=False)
+    for raw in ("nan", "NaN", "inf", "-inf"):
+        d = Deadline.from_header(raw)
+        assert d.budget_s == pytest.approx(20.0), raw
+        assert float(d.header_value()) <= 20_000.0
+
+
 def test_deadline_clamp_shrinks_timeouts():
     d = Deadline(0.1)
     assert d.clamp(20.0) <= 0.1
@@ -104,6 +115,54 @@ def test_limiter_aimd_decrease_and_hold_and_increase():
         lim.acquire()
         lim.release(overloaded=True)
     assert lim.limit == 1.0
+
+
+def test_limiter_reconciles_inverted_bounds(monkeypatch):
+    # min_limit above the (env-default 64) ceiling -- the model server's
+    # 2x-max-bucket floor with default buckets is 256 -- must not invert
+    # the AIMD bounds: release() would clamp decreases UP to min_limit,
+    # RAISING admitted concurrency on congestion.
+    monkeypatch.delenv("KDLT_ADMISSION_MAX_CONCURRENCY", raising=False)
+    lim = AdaptiveLimiter(min_limit=256.0)
+    assert lim.min_limit <= lim.max_limit
+    assert lim.limit >= 256.0
+    lim.acquire()
+    before = lim.limit
+    lim.release(overloaded=True)
+    assert lim.limit <= before  # congestion never raises the limit
+
+
+def test_limiter_timeout_renotifies_next_waiter():
+    # release() issues a single notify; a woken waiter that is already past
+    # its give-up time sheds -- it must pass the wakeup on, or the freed
+    # slot idles while the remaining waiters sleep out their full bound.
+    lim = AdaptiveLimiter(min_limit=1, max_limit=1, initial=1, queue_cap=8,
+                          max_queue_wait_s=5.0)
+    lim.acquire()
+    results: list[str] = []
+
+    def short():
+        try:
+            lim.acquire(budget_s=0.12)  # 30 ms wait bound
+        except Shed:
+            results.append("shed")
+        else:
+            lim.release()
+
+    def long_wait():
+        lim.acquire()  # 5 s bound: plenty once the wakeup is handed on
+        results.append("acquired")
+
+    ta = threading.Thread(target=short)
+    ta.start()
+    time.sleep(0.01)
+    tb = threading.Thread(target=long_wait)
+    tb.start()
+    time.sleep(0.02)  # land the release around the short waiter's give-up
+    lim.release()
+    ta.join(timeout=5)
+    tb.join(timeout=2)
+    assert "acquired" in results, results
 
 
 def test_limiter_release_wakes_waiter():

@@ -123,6 +123,36 @@ def test_gateway_rejects_exhausted_deadline_without_upstream_call(tmp_path):
         gw.shutdown()
 
 
+def test_shed_keeps_pooled_keepalive_connection_usable(tmp_path):
+    # admit() sheds BEFORE the request body is read; on HTTP/1.1 keep-alive
+    # the unread msgpack payload would be parsed as the next request line,
+    # desyncing the pooled connection and failing innocent follow-on
+    # requests with garbage 400s -- exactly in the overload regime the
+    # subsystem targets.  The handler must drain (or close over) it.
+    import requests
+
+    spec, server = _make_stub_server("adm-keepalive", tmp_path)
+    try:
+        session = requests.Session()  # one pooled connection, like the gateway
+        url = f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict"
+        body = protocol.encode_predict_request(
+            np.zeros((1, *spec.input_shape), np.uint8)
+        )
+        headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
+        r = session.post(
+            url, data=body, headers={**headers, DEADLINE_HEADER: "0"}, timeout=10
+        )
+        assert r.status_code == 504
+        for _ in range(3):  # the SAME pooled connection keeps working
+            r = session.post(
+                url, data=body,
+                headers={**headers, DEADLINE_HEADER: "10000"}, timeout=10,
+            )
+            assert r.status_code == 200, (r.status_code, r.text[:200])
+    finally:
+        server.shutdown()
+
+
 # --- shed vs accept under a saturated stub engine --------------------------
 
 
@@ -238,6 +268,41 @@ def test_gateway_breaker_open_half_open_close(tmp_path):
         'kdlt_admission_shed_total{tier="gateway",shed_reason="breaker_open"} 1'
         in gw.registry.render()
     )
+
+
+def test_gateway_503_retry_skipped_without_budget_for_it():
+    # The one-shot 503 retry sleeps UPSTREAM_RETRY_BACKOFF_S; a nearly-
+    # expired request must not burn its last budget sleeping and re-posting
+    # work that cannot finish in time.
+    from kubernetes_deep_learning_tpu.serving.admission import Deadline
+    from kubernetes_deep_learning_tpu.serving.gateway import UpstreamError
+
+    gw = Gateway(serving_host="127.0.0.1:9", model="m", port=0, bind=False)
+    calls = {"n": 0}
+
+    class Overloaded:
+        status_code = 503
+        headers = {"Retry-After": "0.05"}
+        text = "overloaded"
+
+    def overloaded_post(*a, **kw):
+        calls["n"] += 1
+        return Overloaded()
+
+    gw._session().post = overloaded_post
+    img = np.zeros((1, 32, 32, 3), np.uint8)
+    # Ample budget: the 503 earns its one retry (two upstream calls).
+    with pytest.raises(UpstreamError) as exc:
+        gw._predict_batch(img, deadline=Deadline(5.0))
+    assert exc.value.http_status == 503
+    assert calls["n"] == 2
+    # Nearly expired: no room to sleep out the backoff AND complete a
+    # retry -- the 503 surfaces after a single upstream call.
+    calls["n"] = 0
+    with pytest.raises(UpstreamError) as exc:
+        gw._predict_batch(img, deadline=Deadline(0.06))
+    assert exc.value.http_status == 503
+    assert calls["n"] == 1
 
 
 # --- graceful drain ---------------------------------------------------------
