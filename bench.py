@@ -1388,6 +1388,255 @@ def bench_overload_ab(duration_s=8.0, device_ms=100.0, deadline_ms=600.0,
     return out, 0 if ok else 1
 
 
+def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
+                   rate_rps=24.0, hedge_delay_ms=150.0, probe_interval_s=0.5,
+                   kill_at_frac=0.4, seed=0):
+    """Fault-tolerance A/B: hard-kill 1 of 2 model-tier replicas mid-run.
+
+    Device-free acceptance harness for the serving-path fault-tolerance
+    layer (serving.upstream + serving.faults + the dispatcher watchdog's
+    health wiring).  A REAL Gateway fronts TWO stub-backed ModelServer
+    replicas via the comma-separated KDLT_SERVING_HOST form; an open-loop
+    client fires single-image /predict requests (each fetching a local
+    image, each carrying a ``deadline_ms`` budget) at ``rate_rps`` for
+    ``duration_s``; at ``kill_at_frac`` of the way through, replica A is
+    shut down cold (connects refused from that instant).
+
+    Two arms: failover+hedging ON (per-replica health, breakers, /healthz
+    probing every ``probe_interval_s``, hedge after ``hedge_delay_ms``)
+    vs OFF (KDLT_FAILOVER=0 semantics: blind round-robin, one attempt,
+    failures surface).  With failover on, requests that dial the dead
+    replica fail over in-request, so post-kill goodput holds; with it off,
+    success collapses toward the single-replica share (~50%).
+
+    Returns (json_dict, rc); rc=0 iff the ON arm keeps >= 95% of post-kill
+    requests succeeding in-deadline AND recovers within one probe interval
+    (last post-kill failure lands within probe_interval_s + grace of the
+    kill) AND the OFF arm demonstrably collapses (< 85%).
+    """
+    import re
+    import tempfile
+    import threading
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    import requests
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import faults as faults_lib
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    spec = register_spec(
+        ModelSpec(
+            name="chaos-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    deadline_s = deadline_ms / 1e3
+    n_requests = int(duration_s * rate_rps)
+    kill_after_s = kill_at_frac * duration_s
+    rng = np.random.default_rng(seed)
+    img_dir = tempfile.mkdtemp(prefix="kdlt-chaos-img-")
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(img_dir, "img.png"))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    img_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+    log(
+        f"chaos A/B: 2 stub replicas ({device_ms}ms/batch), {rate_rps:g} "
+        f"req/s x {duration_s}s = {n_requests} requests, deadline "
+        f"{deadline_ms:.0f}ms, replica A killed at t+{kill_after_s:.1f}s, "
+        f"hedge {hedge_delay_ms:.0f}ms, probe {probe_interval_s:g}s, "
+        f"seed {seed}"
+    )
+
+    def start_replica() -> ModelServer:
+        root = tempfile.mkdtemp(prefix="kdlt-chaos-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        server = ModelServer(
+            root, port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+            engine_factory=lambda a, **kw: StubEngine(
+                a, device_ms_per_batch=device_ms, **kw
+            ),
+        )
+        server.warmup()
+        server.start()
+        return server
+
+    def run_arm(failover_on: bool) -> dict:
+        victim, survivor = start_replica(), start_replica()
+        gw = Gateway(
+            serving_host=f"127.0.0.1:{victim.port},127.0.0.1:{survivor.port}",
+            model=spec.name, port=0, host="127.0.0.1",
+            failover=failover_on,
+            hedge_delay_ms=hedge_delay_ms if failover_on else 0,
+            probe_interval_s=probe_interval_s,
+        )
+        gw.start()
+        gw.spec  # discover the contract before the clock starts
+        url = f"http://127.0.0.1:{gw.port}/predict"
+        session = requests.Session()
+        session.mount("http://", requests.adapters.HTTPAdapter(
+            pool_connections=4, pool_maxsize=256,
+        ))
+        results: list = [None] * n_requests
+
+        def fire(i: int, at: float) -> None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                r = session.post(
+                    url, json={"url": img_url},
+                    headers={DEADLINE_HEADER: f"{deadline_ms:.1f}"},
+                    timeout=deadline_s + 5.0,
+                )
+                status = r.status_code
+            except Exception:
+                status = -1
+            # Open-loop latency from the SCHEDULED send time.
+            results[i] = (time.monotonic() - at, status)
+
+        t_base = time.monotonic() + 0.25
+        kill_at = t_base + kill_after_s
+        threads = [
+            threading.Thread(
+                target=fire, args=(i, t_base + i / rate_rps), daemon=True
+            )
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+
+        def kill() -> None:
+            delay = kill_at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            # Hard-fail the replica: every in-flight/keep-alive predict
+            # drops its connection mid-request (deterministic injected
+            # disconnect, seeded), and the listener closes so new connects
+            # -- including the gateway's /healthz probes -- are refused.
+            # Both are needed: shutdown() alone leaves the gateway's pooled
+            # keep-alive sockets happily served by their handler threads.
+            victim._faults = faults_lib.FaultInjector(
+                faults_lib.parse_rules("server.predict:disconnect:1.0"),
+                seed=seed,
+            )
+            victim.shutdown()
+
+        killer = threading.Thread(target=kill, daemon=True)
+        killer.start()
+        end_by = t_base + duration_s + max(2.0, 2 * deadline_s)
+        for t in threads:
+            t.join(timeout=max(0.0, end_by - time.monotonic()))
+        killer.join(timeout=10.0)
+        gw_metrics = gw.registry.render()
+        gw.shutdown()
+        survivor.shutdown()
+        sched = [t_base + i / rate_rps for i in range(n_requests)]
+        done = [
+            (sched[i], lat, status)
+            for i, r in enumerate(results) if r is not None
+            for lat, status in [r]
+        ]
+        ok = [
+            (at, lat) for at, lat, status in done
+            if status == 200 and lat <= deadline_s
+        ]
+        post_kill = [(at, lat, status) for at, lat, status in done if at >= kill_at]
+        post_ok = [
+            (at, lat) for at, lat, status in post_kill
+            if status == 200 and lat <= deadline_s
+        ]
+        post_failures = [
+            at for at, lat, status in post_kill
+            if not (status == 200 and lat <= deadline_s)
+        ]
+        # Recovery: how long after the kill failures kept being SCHEDULED.
+        recovery_s = (max(post_failures) - kill_at) if post_failures else 0.0
+
+        def metric(name: str) -> float:
+            m = re.search(rf"^{name}(?:\{{[^}}]*\}})? (\S+)$", gw_metrics, re.M)
+            return float(m.group(1)) if m else 0.0
+
+        arm = {
+            "failover": failover_on,
+            "requests": n_requests,
+            "resolved": len(done),
+            "in_deadline_rate": round(len(ok) / max(1, len(done)), 4),
+            "post_kill_requests": len(post_kill),
+            "post_kill_in_deadline_rate": round(
+                len(post_ok) / max(1, len(post_kill)), 4
+            ),
+            "post_kill_failures": len(post_failures),
+            "recovery_s": round(recovery_s, 3),
+            "failover_total": metric("kdlt_upstream_failover_total"),
+            "hedge_fired_total": metric("kdlt_hedge_fired_total"),
+            "hedge_won_total": metric("kdlt_hedge_won_total"),
+        }
+        log(
+            f"  failover={'on ' if failover_on else 'off'}: post-kill "
+            f"{arm['post_kill_in_deadline_rate'] * 100:5.1f}% in-deadline "
+            f"({len(post_ok)}/{len(post_kill)}), recovery {recovery_s:.2f}s, "
+            f"{arm['failover_total']:.0f} failovers, "
+            f"{arm['hedge_fired_total']:.0f} hedges fired "
+            f"({arm['hedge_won_total']:.0f} won)"
+        )
+        return arm
+
+    try:
+        arm_on = run_arm(True)
+        arm_off = run_arm(False)
+    finally:
+        img_httpd.shutdown()
+    # Recovery bound: in-request failover means failures should stop almost
+    # immediately; one probe interval (+ scheduling grace) is the ceiling.
+    recovery_bound_s = probe_interval_s + 0.5
+    ok = (
+        arm_on["post_kill_in_deadline_rate"] >= 0.95
+        and arm_on["recovery_s"] <= recovery_bound_s
+        and arm_off["post_kill_in_deadline_rate"] < 0.85
+    )
+    out = {
+        "metric": (
+            f"serving-path chaos A/B (2 stub replicas, 1 hard-killed at "
+            f"t+{kill_after_s:.1f}s of {duration_s:g}s, {deadline_ms:.0f}ms "
+            f"deadline): post-kill in-deadline success with failover+hedging "
+            f"on vs off; recovery {arm_on['recovery_s']:.2f}s "
+            f"(bound {recovery_bound_s:.2f}s)"
+        ),
+        "value": round(arm_on["post_kill_in_deadline_rate"], 4),
+        "unit": "post-kill in-deadline success rate (failover on)",
+        "vs_baseline": round(
+            arm_on["post_kill_in_deadline_rate"]
+            / max(arm_off["post_kill_in_deadline_rate"], 1e-9),
+            2,
+        ),
+        "deadline_ms": deadline_ms,
+        "rate_rps": rate_rps,
+        "hedge_delay_ms": hedge_delay_ms,
+        "probe_interval_s": probe_interval_s,
+        "seed": seed,
+        "arms": {"failover_on": arm_on, "failover_off": arm_off},
+    }
+    return out, 0 if ok else 1
+
+
 def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl,
                           max_delay_ms, stub_device_ms=0.0):
     """Can the HTTP + protocol + batcher host path carry the target WITHOUT
@@ -1725,6 +1974,40 @@ def main() -> int:
         help="bucket ladder for the --overload-ab stub tier",
     )
     p.add_argument(
+        "--chaos-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: serving-path fault-tolerance A/B -- "
+             "front two stub model-tier replicas with the real gateway, "
+             "hard-kill one mid-run, and report post-kill in-deadline "
+             "success + recovery time with failover+hedging on vs off "
+             "(no device needed; rc=0 iff the on arm holds >=95% and "
+             "recovers within one probe interval while the off arm "
+             "collapses toward the single-replica share)",
+    )
+    p.add_argument(
+        "--chaos-device-ms", type=float, default=30.0,
+        help="simulated device ms per batch for the --chaos-ab stub replicas",
+    )
+    p.add_argument(
+        "--chaos-deadline-ms", type=float, default=2000.0,
+        help="per-request deadline budget for --chaos-ab",
+    )
+    p.add_argument(
+        "--chaos-rate-rps", type=float, default=24.0,
+        help="offered request rate for --chaos-ab",
+    )
+    p.add_argument(
+        "--chaos-hedge-ms", type=float, default=150.0,
+        help="hedge delay for the --chaos-ab failover-on arm",
+    )
+    p.add_argument(
+        "--chaos-probe-s", type=float, default=0.5,
+        help="replica /healthz probe interval for --chaos-ab",
+    )
+    p.add_argument(
+        "--chaos-seed", type=int, default=0,
+        help="deterministic seed for the --chaos-ab request schedule",
+    )
+    p.add_argument(
         "--dry-run", action="store_true",
         help="parse arguments, echo the resolved run configuration as one "
              "JSON line, and exit 0 -- a CI smoke so bench refactors can "
@@ -1774,7 +2057,7 @@ def main() -> int:
         # line; no jax import, no device dial, no subprocesses.
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "batcher_sweep",
-                     "host_saturation", "overload_ab"):
+                     "host_saturation", "overload_ab", "chaos_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -1795,6 +2078,14 @@ def main() -> int:
                 "deadline_ms": args.overload_deadline_ms,
                 "rate_x": args.overload_rate_x,
                 "buckets": [int(b) for b in args.overload_buckets.split(",")],
+            },
+            "chaos": {
+                "device_ms": args.chaos_device_ms,
+                "deadline_ms": args.chaos_deadline_ms,
+                "rate_rps": args.chaos_rate_rps,
+                "hedge_ms": args.chaos_hedge_ms,
+                "probe_s": args.chaos_probe_s,
+                "seed": args.chaos_seed,
             },
         }), flush=True)
         return 0
@@ -1849,6 +2140,19 @@ def main() -> int:
             rate_x=args.overload_rate_x,
             buckets=tuple(int(b) for b in args.overload_buckets.split(",")),
             max_delay_ms=args.max_delay_ms,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.chaos_ab > 0:
+        out, rc = bench_chaos_ab(
+            duration_s=args.chaos_ab,
+            device_ms=args.chaos_device_ms,
+            deadline_ms=args.chaos_deadline_ms,
+            rate_rps=args.chaos_rate_rps,
+            hedge_delay_ms=args.chaos_hedge_ms,
+            probe_interval_s=args.chaos_probe_s,
+            seed=args.chaos_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

@@ -1,5 +1,6 @@
 from kubernetes_deep_learning_tpu.runtime.engine import (
     DispatcherClosed,
+    DispatchStall,
     InferenceEngine,
     InFlightDispatcher,
     resolve_pipeline_depth,
@@ -54,6 +55,7 @@ def create_batcher(engine, impl: str = "auto", dispatcher=None, **kwargs):
 
 __all__ = [
     "BatcherClosed",
+    "DispatchStall",
     "DispatcherClosed",
     "DynamicBatcher",
     "InferenceEngine",

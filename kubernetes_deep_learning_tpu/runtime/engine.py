@@ -42,6 +42,27 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 PIPELINE_DEPTH_ENV = "KDLT_PIPELINE_DEPTH"
 DEFAULT_PIPELINE_DEPTH = 2
 
+# Engine watchdog (serving-path fault tolerance): an in-flight dispatch
+# handle stuck beyond ``multiple`` x the bucket's expected latency (EWMA of
+# observed completions; ``floor`` seconds until there are samples, and
+# never below the floor) is declared stalled -- its future fails with the
+# retryable DispatchStall, the dispatcher flips unhealthy (the model
+# server's /healthz follows, so the orchestrator restarts the pod), and
+# kdlt_dispatch_stall_total counts it.  KDLT_WATCHDOG=0 disables.
+WATCHDOG_ENV = "KDLT_WATCHDOG"
+WATCHDOG_MULTIPLE_ENV = "KDLT_WATCHDOG_MULTIPLE"
+WATCHDOG_FLOOR_S_ENV = "KDLT_WATCHDOG_FLOOR_S"
+DEFAULT_WATCHDOG_MULTIPLE = 10.0
+DEFAULT_WATCHDOG_FLOOR_S = 30.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
 
 def resolve_pipeline_depth(depth: int | None = None) -> int:
     """The in-flight dispatch depth: explicit arg > $KDLT_PIPELINE_DEPTH > 2.
@@ -65,6 +86,17 @@ def resolve_pipeline_depth(depth: int | None = None) -> int:
 
 class DispatcherClosed(RuntimeError):
     """The in-flight dispatcher has been permanently shut down."""
+
+
+class DispatchStall(RuntimeError):
+    """An in-flight dispatch was declared stuck by the watchdog.
+
+    Retryable from the caller's point of view (another replica can serve
+    the request); for THIS process it is terminal evidence -- the
+    completion thread is wedged on a device sync that never returns, so
+    the dispatcher stops intake and the serving health check fails until
+    the orchestrator restarts the pod.
+    """
 
 
 class InFlightDispatcher:
@@ -102,7 +134,10 @@ class InFlightDispatcher:
     """
 
     def __init__(self, engine, depth: int | None = None,
-                 registry: metrics_lib.Registry | None = None):
+                 registry: metrics_lib.Registry | None = None,
+                 watchdog: bool | None = None,
+                 stall_multiple: float | None = None,
+                 stall_floor_s: float | None = None):
         self._engine = engine
         self.depth = resolve_pipeline_depth(depth)
         self._slots = threading.Semaphore(self.depth)
@@ -117,10 +152,48 @@ class InFlightDispatcher:
             "kdlt_pipeline_depth", "configured in-flight dispatch depth"
         )
         self._m_depth.set(float(self.depth))
+        self._m_stalls = metrics_lib.dispatch_stall_counter(registry)
+        # Fault injection (serving.faults): dispatch.submit / dispatch.complete
+        # points; None (the inert fast path) unless $KDLT_FAULTS configures.
+        from kubernetes_deep_learning_tpu.serving import faults as faults_lib
+
+        self._faults = faults_lib.from_env()
+        # Watchdog state: in-flight ledger (token -> (future, batch rows,
+        # dispatch time)) the watchdog scans, per-bucket EWMA of observed
+        # dispatch->sync latency, and the terminal "stalled" flag.
+        self._stalled = threading.Event()
+        self._inflight: dict[int, tuple[Future, int, float]] = {}
+        self._inflight_lock = threading.Lock()
+        self._seq = 0
+        self._expected_s: dict[int, float] = {}
+        if watchdog is None:
+            watchdog = os.environ.get(WATCHDOG_ENV, "").strip() != "0"
+        self._stall_multiple = (
+            stall_multiple if stall_multiple is not None
+            else _env_float(WATCHDOG_MULTIPLE_ENV, DEFAULT_WATCHDOG_MULTIPLE)
+        )
+        self._stall_floor_s = (
+            stall_floor_s if stall_floor_s is not None
+            else _env_float(WATCHDOG_FLOOR_S_ENV, DEFAULT_WATCHDOG_FLOOR_S)
+        )
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread = None
+        if watchdog and self._stall_floor_s > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="kdlt-dispatch-watchdog",
+                daemon=True,
+            )
+            self._watchdog_thread.start()
         self._thread = threading.Thread(
             target=self._complete_loop, name="kdlt-dispatch-readback", daemon=True
         )
         self._thread.start()
+
+    @property
+    def stalled(self) -> bool:
+        """True once the watchdog declared an in-flight dispatch stuck; the
+        dispatcher no longer accepts work and serving health should fail."""
+        return self._stalled.is_set()
 
     def submit(self, images: np.ndarray) -> Future:
         """Dispatch one uint8 batch; returns a Future of its logits rows.
@@ -128,22 +201,37 @@ class InFlightDispatcher:
         Blocks only while ``depth`` batches are in flight (backpressure) --
         never on device execution of the batch itself.
         """
+        if self._stalled.is_set():
+            # The completion thread is wedged on a sync that never returns;
+            # slots will never free, so blocking on one would hang the
+            # caller.  Fail fast and retryably (another replica can serve).
+            raise DispatchStall("dispatch pipeline is stalled")
         t0 = time.perf_counter()
         self._slots.acquire()
         if self._closed:
             self._slots.release()
             raise DispatcherClosed("dispatcher is shut down")
+        if self._stalled.is_set():
+            self._slots.release()
+            raise DispatchStall("dispatch pipeline is stalled")
         self._m_stage["enqueue_wait"].observe(time.perf_counter() - t0)
         fut: Future = Future()
         t1 = time.perf_counter()
         try:
+            if self._faults is not None:
+                self._faults.fire("dispatch.submit")
             handle, n = self._engine.predict_async(images)
         except Exception as e:  # dispatch failure belongs to THIS future
             self._slots.release()
             fut.set_exception(e)
             return fut
         self._m_stage["dispatch"].observe(time.perf_counter() - t1)
-        self._completions.put((handle, n, fut, time.perf_counter()))
+        dispatched_at = time.perf_counter()
+        with self._inflight_lock:
+            token = self._seq
+            self._seq += 1
+            self._inflight[token] = (fut, n, dispatched_at)
+        self._completions.put((handle, n, fut, dispatched_at, token))
         return fut
 
     def _complete_loop(self) -> None:
@@ -153,14 +241,20 @@ class InFlightDispatcher:
                 return
             self._complete_one(*item)
 
-    def _complete_one(self, handle, n: int, fut: Future, dispatched_at: float) -> None:
+    def _complete_one(
+        self, handle, n: int, fut: Future, dispatched_at: float, token: int
+    ) -> None:
         """MUST NOT raise: an exception escaping here kills the completion
         thread, which strands every later batch's waiters AND deadlocks
         close() -- so anything unexpected fails THIS future instead."""
         t0 = time.perf_counter()
         try:
+            if self._faults is not None:
+                self._faults.fire("dispatch.complete")
             rows = np.asarray(handle)[:n]  # blocking device sync + D2H
         except Exception as e:  # device-side failure surfaces at sync
+            with self._inflight_lock:
+                self._inflight.pop(token, None)
             self._slots.release()
             if not fut.cancelled():
                 fut.set_exception(e)
@@ -168,6 +262,9 @@ class InFlightDispatcher:
         t1 = time.perf_counter()
         self._m_stage["execute"].observe(t0 - dispatched_at)
         self._m_stage["readback"].observe(t1 - t0)
+        self._observe_latency(n, t1 - dispatched_at)
+        with self._inflight_lock:
+            self._inflight.pop(token, None)
         try:
             if hasattr(self._engine, "record_completed"):
                 # The engine accounts only its own synchronous path;
@@ -183,6 +280,86 @@ class InFlightDispatcher:
         except Exception:  # noqa: BLE001 - cancel race on an abandoned future
             pass
 
+    # --- watchdog ----------------------------------------------------------
+
+    def _bucket_of(self, n: int) -> int:
+        bucket_for = getattr(self._engine, "bucket_for", None)
+        if bucket_for is None:
+            return n
+        try:
+            return bucket_for(n)
+        except Exception:  # noqa: BLE001 - accounting key only
+            return n
+
+    def _observe_latency(self, n: int, seconds: float) -> None:
+        """Per-bucket EWMA of dispatch->sync latency; the watchdog's notion
+        of "expected"."""
+        b = self._bucket_of(n)
+        with self._inflight_lock:
+            prev = self._expected_s.get(b)
+            self._expected_s[b] = (
+                seconds if prev is None else 0.7 * prev + 0.3 * seconds
+            )
+
+    def _stall_bound_s(self, n: int) -> float:
+        """How long an in-flight dispatch of ``n`` rows may run before it
+        is stuck: multiple x the bucket's EWMA, never below the floor (and
+        exactly the floor until the bucket has a sample)."""
+        with self._inflight_lock:
+            expected = self._expected_s.get(self._bucket_of(n))
+        if expected is None:
+            return self._stall_floor_s
+        return max(self._stall_floor_s, self._stall_multiple * expected)
+
+    def _watchdog_loop(self) -> None:
+        interval = max(0.01, min(1.0, self._stall_floor_s / 5.0))
+        while not self._watchdog_stop.wait(interval):
+            if self._check_stall():
+                return  # terminal: the pipeline is declared dead
+
+    def _check_stall(self) -> bool:
+        """One watchdog scan; returns True when a stall was declared."""
+        now = time.perf_counter()
+        with self._inflight_lock:
+            entries = list(self._inflight.items())
+        overdue = [
+            (token, fut, n)
+            for token, (fut, n, t0) in entries
+            if now - t0 > self._stall_bound_s(n)
+        ]
+        if not overdue:
+            return False
+        # The completion thread materializes in FIFO order, so one stuck
+        # handle blocks every later in-flight batch too: fail ALL current
+        # waiters (retryable), stop intake, and flip unhealthy -- this
+        # process needs a restart, its callers need another replica.
+        self._stalled.set()
+        with self._inflight_lock:
+            stranded = list(self._inflight.items())
+            self._inflight.clear()
+        import logging
+
+        logging.getLogger(__name__).error(
+            "dispatch watchdog: %d in-flight batch(es) stuck past their "
+            "stall bound (oldest %.1fs); failing %d waiter(s) and marking "
+            "the pipeline stalled",
+            len(overdue),
+            max(now - t0 for _, (_, _, t0) in entries),
+            len(stranded),
+        )
+        for _token, (fut, _n, _t0) in stranded:
+            self._m_stalls.inc()
+            try:
+                if not fut.done():
+                    fut.set_exception(
+                        DispatchStall(
+                            "in-flight dispatch exceeded its stall bound"
+                        )
+                    )
+            except Exception:  # noqa: BLE001 - racing completion
+                pass
+        return True
+
     def close(self, drain: bool = True) -> None:
         """Stop intake, drain every in-flight batch, stop the completion
         thread.
@@ -195,18 +372,29 @@ class InFlightDispatcher:
         for signature symmetry with the batchers but behaves identically:
         work already dispatched is on the device regardless, so its waiters
         are always resolved.
+
+        A STALLED dispatcher cannot quiesce (the completion thread is
+        wedged and its slots never free): close skips the drain, leaving
+        the daemon threads to die with the process -- which is imminent,
+        since the stall already failed the health check.
         """
         del drain
+        self._watchdog_stop.set()
         with self._close_lock:
             if self._closed:
                 return
-            for _ in range(self.depth):  # wait out the in-flight batches
-                self._slots.acquire()
-            self._closed = True
-            for _ in range(self.depth):  # wake blocked submits -> they raise
-                self._slots.release()
+            if not self._stalled.is_set():
+                for _ in range(self.depth):  # wait out the in-flight batches
+                    self._slots.acquire()
+                self._closed = True
+                for _ in range(self.depth):  # wake blocked submits -> raise
+                    self._slots.release()
+            else:
+                self._closed = True
         self._completions.put(None)
-        self._thread.join(timeout=30.0)
+        self._thread.join(timeout=0.5 if self._stalled.is_set() else 30.0)
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout=5.0)
 
 
 class InferenceEngine:

@@ -113,6 +113,17 @@ class CircuitBreaker:
         self._opened_at = self._clock()
         self._consecutive_failures = 0
 
+    def reset(self) -> None:
+        """Force CLOSED with clean counters: out-of-band recovery evidence
+        (the upstream pool's active /healthz probe succeeding) supersedes
+        the time-based cool-down -- failover recovery must not wait out an
+        OPEN window on a replica already proven healthy."""
+        with self._lock:
+            self.state = CLOSED
+            self._consecutive_failures = 0
+            self._probes_issued = 0
+            self._probe_successes = 0
+
     def retry_after_s(self) -> float:
         """Remaining cool-down before half-open probing (0 when not OPEN)."""
         with self._lock:

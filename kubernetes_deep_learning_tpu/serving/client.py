@@ -33,6 +33,7 @@ def predict_url(
     timeout: float = 30.0,
     retries: int = 2,
     deadline_ms: float | None = None,
+    stats: dict | None = None,
 ) -> dict:
     """POST {"url": ...} to the gateway's /predict (reference test.py:15).
 
@@ -40,12 +41,25 @@ def predict_url(
     queue full, draining replica, open circuit breaker), so instead of
     raising immediately the client retries up to ``retries`` times, sleeping
     for the server's ``Retry-After`` hint (capped, jittered) -- but never
-    past its own ``timeout`` budget.  ``deadline_ms`` states an end-to-end
+    past its own ``timeout`` budget.  Connection-level failures (refused,
+    reset mid-response -- a gateway replica dying under the request) share
+    the same jittered, deadline-bounded retry budget: the request never
+    reached/completed on the serving path, so resending is safe and usually
+    lands on a healthy replica.  ``deadline_ms`` states an end-to-end
     deadline budget via the X-Request-Deadline-Ms header; the serving path
     then derives every queue wait and upstream timeout from what remains.
+
+    ``stats``, if given, collects retry accounting under distinct labels:
+    ``retried_shed`` (503 + Retry-After) vs ``retried_connect`` (connect/
+    reset) -- the CLI prints them separately so an operator can tell
+    overload from instability at a glance.
     """
     import requests
 
+    if stats is None:
+        stats = {}
+    stats.setdefault("retried_shed", 0)
+    stats.setdefault("retried_connect", 0)
     headers = {}
     if deadline_ms is not None:
         from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
@@ -53,12 +67,25 @@ def predict_url(
         headers[DEADLINE_HEADER] = f"{float(deadline_ms):.1f}"
     t0 = time.monotonic()
     for attempt in range(retries + 1):
-        r = requests.post(
-            f"{gateway_url}/predict",
-            json={"url": image_url},
-            headers=headers,
-            timeout=timeout,
-        )
+        try:
+            r = requests.post(
+                f"{gateway_url}/predict",
+                json={"url": image_url},
+                headers=headers,
+                timeout=timeout,
+            )
+        except requests.ConnectionError:
+            # Refused/reset: the same bounded, jittered backoff as a shed,
+            # labeled distinctly (this is instability, not overload).
+            if attempt >= retries:
+                raise
+            delay = DEFAULT_RETRY_BACKOFF_S
+            delay += random.uniform(0.0, delay * 0.25 + 0.01)
+            if time.monotonic() - t0 + delay > timeout:
+                raise
+            stats["retried_connect"] += 1
+            time.sleep(delay)
+            continue
         if r.status_code != 503 or attempt >= retries:
             r.raise_for_status()
             return r.json()
@@ -70,6 +97,7 @@ def predict_url(
         delay += random.uniform(0.0, delay * 0.25 + 0.01)  # decorrelate herds
         if time.monotonic() - t0 + delay > timeout:
             r.raise_for_status()  # out of budget: surface the 503
+        stats["retried_shed"] += 1
         time.sleep(delay)
     raise AssertionError("unreachable")  # loop always returns or raises
 
@@ -105,11 +133,20 @@ def main(argv: list[str] | None = None) -> int:
         help="bounded retries on 503 shed responses (honors Retry-After)",
     )
     args = p.parse_args(argv)
+    stats: dict = {}
     scores = predict_url(
         args.gateway, args.image_url,
-        retries=args.retries, deadline_ms=args.deadline_ms,
+        retries=args.retries, deadline_ms=args.deadline_ms, stats=stats,
     )
     print(json.dumps(scores, indent=2))
+    if stats.get("retried_shed") or stats.get("retried_connect"):
+        # Distinct labels: shed retries mean overload (the tier said wait),
+        # connect retries mean instability (a replica dropped the request).
+        print(
+            f"# retried: {stats['retried_shed']} shed (503/Retry-After), "
+            f"{stats['retried_connect']} connect/reset",
+            file=sys.stderr,
+        )
     return 0
 
 

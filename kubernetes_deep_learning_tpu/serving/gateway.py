@@ -17,7 +17,12 @@ Differences, all TPU-first:
   hardcoded (reference model_server.py:18,21-32,40-47);
 - service discovery stays env-var based: ``KDLT_SERVING_HOST`` with a
   localhost default, exactly like the reference's ``TF_SERVING_HOST``
-  (reference model_server.py:13, serving-gateway-deployment.yaml:22-24).
+  (reference model_server.py:13, serving-gateway-deployment.yaml:22-24) --
+  but the value may be a comma-separated REPLICA LIST (serving.upstream):
+  per-replica health + circuit breakers, automatic failover on connect
+  errors and 5xx, and deadline-budget-aware hedged requests
+  (``KDLT_HEDGE_DELAY_MS``), so the gateway survives a model-tier replica
+  dying instead of outsourcing all availability to the orchestrator.
 """
 
 from __future__ import annotations
@@ -37,18 +42,19 @@ from kubernetes_deep_learning_tpu.serving import protocol
 from kubernetes_deep_learning_tpu.serving.admission import (
     DEADLINE_HEADER,
     AdmissionController,
-    CircuitBreaker,
     Deadline,
     Shed,
     install_sigterm_drain,
     retry_after_headers,
 )
+from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 from kubernetes_deep_learning_tpu.serving.microbatch import UpstreamStall
 from kubernetes_deep_learning_tpu.serving.tracing import (
     REQUEST_ID_HEADER,
     ensure_request_id,
     log_request,
 )
+from kubernetes_deep_learning_tpu.serving.upstream import UpstreamPool, parse_hosts
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 DEFAULT_PORT = 9696          # reference gateway port (gateway.dockerfile:15-16)
@@ -99,6 +105,9 @@ class Gateway:
         upstream_batch: int = 0,
         upstream_delay_ms: float = 2.0,
         admission: bool | None = None,
+        failover: bool | None = None,
+        hedge_delay_ms: float | None = None,
+        probe_interval_s: float | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -126,10 +135,8 @@ class Gateway:
             SERVING_HOST_ENV, DEFAULT_SERVING_HOST
         )
         self.model = model or os.environ.get(MODEL_ENV, DEFAULT_MODEL)
-        self._base = f"http://{self.serving_host}"
         self._session_obj = None
         self._session_lock = threading.Lock()
-        self._spec: ModelSpec | None = None
         self._spec_lock = threading.Lock()
 
         self.registry = metrics_lib.Registry()
@@ -150,7 +157,23 @@ class Gateway:
         self.admission = AdmissionController(
             self.registry, tier="gateway", enabled=admission
         )
-        self.breaker = CircuitBreaker()
+        # Multi-replica upstream pool (serving.upstream): replica list from
+        # the serving host, per-replica health + breaker, hedging policy.
+        # With a single replica this degrades to exactly the PR 2 posture
+        # (one breaker, no failover possible).
+        self.pool = UpstreamPool(
+            parse_hosts(self.serving_host),
+            registry=self.registry,
+            failover=failover,
+            hedge_delay_ms=hedge_delay_ms,
+            probe_interval_s=probe_interval_s,
+        )
+        self.pool.start_probing()
+        # Fault injection (serving.faults): the gateway.upstream point;
+        # None (zero-overhead) unless $KDLT_FAULTS configures rules.
+        self._faults = faults_lib.from_env()
+        if self._faults is not None:
+            self._faults.attach(self.registry)
 
         self._httpd = None
         self.port = port
@@ -180,22 +203,52 @@ class Gateway:
         return self._session_obj
 
     @property
-    def spec(self) -> ModelSpec:
-        """The served model's contract, discovered from the model tier."""
-        if self._spec is None:
-            import requests
+    def breaker(self):
+        """The first replica's circuit breaker (back-compat: the PR 2
+        single-upstream surface; per-replica breakers live on the pool)."""
+        return self.pool.replicas[0].breaker
 
-            with self._spec_lock:
-                if self._spec is None:
-                    try:
-                        r = self._session().get(
-                            f"{self._base}/v1/models/{self.model}", timeout=10
-                        )
-                        r.raise_for_status()
-                    except requests.RequestException as e:
-                        raise UpstreamError(f"model spec discovery failed: {e}") from e
-                    self._spec = ModelSpec.from_json(r.text)
-        return self._spec
+    @breaker.setter
+    def breaker(self, value) -> None:
+        self.pool.replicas[0].breaker = value
+
+    def _fetch_spec(self, replica) -> ModelSpec:
+        """GET one replica's /v1/models/<name> contract (RequestException
+        propagates -- the caller decides whether that means failover)."""
+        r = self._session().get(
+            f"{replica.base}/v1/models/{self.model}", timeout=10
+        )
+        r.raise_for_status()
+        return ModelSpec.from_json(r.text)
+
+    @property
+    def spec(self) -> ModelSpec:
+        """The served model's contract, discovered from the model tier.
+
+        Discovery sweeps the replica pool (healthy replicas first) and the
+        first answer becomes the pool's ``reference_spec`` -- the contract
+        every other replica is validated against before serving traffic
+        (see _validate_replica_spec).
+        """
+        if self.pool.reference_spec is not None:
+            return self.pool.reference_spec
+        import requests
+
+        with self._spec_lock:
+            if self.pool.reference_spec is not None:
+                return self.pool.reference_spec
+            last_exc: Exception | None = None
+            for replica in self.pool.snapshot_ordered():
+                try:
+                    replica.spec = self._fetch_spec(replica)
+                except requests.RequestException as e:
+                    last_exc = e
+                    continue
+                self.pool.reference_spec = replica.spec
+                return replica.spec
+            raise UpstreamError(
+                f"model spec discovery failed: {last_exc}"
+            ) from last_exc
 
     def _fetch_one(self, url: str):
         """url -> resized uint8 HWC image (host-side half of the pipeline)."""
@@ -208,72 +261,277 @@ class Gateway:
         self._m_fetch.observe(time.perf_counter() - t0)
         return image
 
+    def _validate_replica_spec(self, replica) -> None:
+        """Failover spec re-validation: before a replica other than the
+        reference source serves traffic, its contract must match the pool's
+        reference -- a replica left serving a different model version
+        surfaces as an explicit 502, never silently mixed responses.
+
+        Only runs once a reference exists and only until the replica's spec
+        is cached (it is re-cleared when the replica rejoins after being
+        unhealthy).  RequestException propagates: an unreachable replica is
+        a connect failure, which the failover loop routes around.
+        """
+        reference = self.pool.reference_spec
+        if reference is None:
+            return
+        if replica.spec is None:
+            replica.spec = self._fetch_spec(replica)
+        if replica.spec.to_json() != reference.to_json():
+            self.pool.mark_spec_mismatch(replica)
+            raise UpstreamError(
+                f"model-tier replica {replica.host} serves a different "
+                f"model contract than the pool reference", 502,
+            )
+
+    def _post_once(self, replica, body, request_id, deadline, timeout):
+        """One upstream POST to one replica (headers re-measured now)."""
+        if self._faults is not None:
+            self._faults.fire("gateway.upstream")
+        headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
+        if request_id:  # cross-tier trace propagation
+            headers[REQUEST_ID_HEADER] = request_id
+        if deadline is not None:  # remaining budget, re-measured now
+            headers[DEADLINE_HEADER] = deadline.header_value()
+        return self._session().post(
+            f"{replica.base}/v1/models/{self.model}:predict",
+            data=body,
+            headers=headers,
+            timeout=timeout,
+        )
+
+    def _post_hedged(
+        self, primary, body, request_id, deadline, timeout, tried
+    ):
+        """POST with a deadline-budget-aware hedged second attempt.
+
+        If the primary has not answered within the pool's hedge delay AND
+        another healthy replica exists AND the remaining budget can still
+        cover a useful attempt, a second request fires against that
+        replica; the first usable answer wins and the loser is abandoned
+        (its daemon thread drains the response into the connection pool --
+        plain HTTP/1.1 has no cancel).  Tail-at-scale hedging: the hedge
+        only ever duplicates the slowest requests, so the added load is
+        bounded by the hedge-delay percentile.
+
+        Returns ``(winning_replica, response)``.  If every attempt raised,
+        failures are recorded for the hedge replica (the caller records the
+        primary's), the hedge replica is appended to ``tried``, and the
+        primary's exception re-raises.
+        """
+        pool = self.pool
+        delay = pool.hedge_delay_s
+        hedgeable = (
+            pool.failover
+            and delay > 0
+            and pool.has_healthy_candidate(exclude=[primary, *tried])
+            and (
+                deadline is None
+                or deadline.remaining_s() > delay + MIN_RETRY_BUDGET_S
+            )
+        )
+        if not hedgeable:
+            return primary, self._post_once(
+                primary, body, request_id, deadline, timeout
+            )
+        import queue as queue_lib
+
+        results: queue_lib.Queue = queue_lib.Queue()
+
+        def attempt(rep):
+            try:
+                results.put((rep, self._post_once(rep, body, request_id, deadline, timeout), None))
+            except Exception as e:  # noqa: BLE001 - reported via the queue
+                results.put((rep, None, e))
+
+        threading.Thread(
+            target=attempt, args=(primary,), name="kdlt-upstream-primary",
+            daemon=True,
+        ).start()
+        try:
+            first = results.get(timeout=delay)
+        except queue_lib.Empty:
+            first = None
+        hedge = None
+        if first is None:
+            # Primary is slow past the hedge delay: fire the hedge.
+            hedge = pool.choose(
+                exclude=[primary, *tried],
+                gate_breaker=self.admission.enabled,
+            )
+            if hedge is None:
+                first = results.get()
+            else:
+                if pool.m_hedge_fired is not None:
+                    pool.m_hedge_fired.inc()
+                threading.Thread(
+                    target=attempt, args=(hedge,), name="kdlt-upstream-hedge",
+                    daemon=True,
+                ).start()
+                first = results.get()
+        outcomes = [first]
+        if hedge is not None and not self._usable(first):
+            # The faster attempt failed; the slower one may still win.
+            outcomes.append(results.get())
+        winner = next((o for o in outcomes if self._usable(o)), None)
+        if winner is None:
+            # No usable answer; prefer returning a 5xx response (the
+            # caller's 503/failover policy applies) over raising.
+            winner = next((o for o in outcomes if o[1] is not None), None)
+        if winner is not None:
+            rep, r, _exc = winner
+            for lrep, lr, lexc in outcomes:
+                if lrep is rep:
+                    continue  # the caller accounts the winner's outcome
+                if lexc is not None or (lr is not None and lr.status_code >= 500):
+                    pool.record_failure(lrep)
+                    if lrep not in tried:
+                        tried.append(lrep)  # a known-bad failover target
+            if hedge is not None and rep is hedge and pool.m_hedge_won is not None:
+                pool.m_hedge_won.inc()
+            return rep, r
+        # Every observed attempt raised: account the hedge's failure here
+        # (the caller only knows the primary) and re-raise the primary's.
+        primary_exc = None
+        for lrep, _lr, lexc in outcomes:
+            if lrep is primary:
+                primary_exc = lexc
+                continue
+            pool.record_failure(lrep)
+            if lrep not in tried:
+                tried.append(lrep)
+        raise primary_exc if primary_exc is not None else outcomes[-1][2]
+
+    @staticmethod
+    def _usable(outcome) -> bool:
+        """A hedged attempt outcome worth returning: a response that is not
+        a server-side failure (2xx-4xx means the tier is up and judged the
+        request on its merits)."""
+        _rep, r, exc = outcome
+        return exc is None and r is not None and r.status_code < 500
+
+    @staticmethod
+    def _status_error(r) -> UpstreamError:
+        """Map a non-200 upstream response to the client-facing error."""
+        status = 503 if r.status_code == 503 else 502
+        retry_after = None
+        if status == 503:
+            try:
+                retry_after = float(r.headers.get("Retry-After", ""))
+            except (TypeError, ValueError):
+                retry_after = None
+        return UpstreamError(
+            f"model server error {r.status_code}: {r.text[:200]}",
+            status,
+            retry_after_s=retry_after,
+        )
+
     def _predict_batch(
         self, images, request_id: str = "", deadline: Deadline | None = None
     ) -> tuple[list, list[str]]:
         """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
 
-        One retry on 503: that status is the model tier's explicit transient
-        overload signal (batcher QueueFull), so a brief backoff usually
-        succeeds and spares the client a round trip; anything else fails
-        straight through.
+        Failure policy over the replica pool (serving.upstream):
 
-        Deadline-aware: the read timeout is clamped to the request's
-        remaining budget (a caller that will give up in 800 ms must not
-        hold this thread for 20 s), the REMAINING budget travels upstream
-        in the deadline header, and the circuit breaker refuses the call
-        outright while the model tier is known-unhealthy.
+        - a connect error / injected fault fails over to the next replica
+          (passive health + breaker bookkeeping per replica) until the
+          pool or the deadline budget is exhausted;
+        - a 503 (the tier's explicit transient overload signal) fails over
+          immediately when another HEALTHY replica exists; otherwise it
+          keeps PR 2's single-upstream shape -- one brief backoff retry
+          against the same replica, budget permitting;
+        - slow responses are hedged to a second replica after the hedge
+          delay (_post_hedged), budget permitting;
+        - when every replica is refused up front (breakers open), the
+          request sheds locally as breaker_open, Retry-After = the
+          soonest any replica might recover.
+
+        Deadline-aware throughout: the read timeout is clamped to the
+        request's remaining budget (a caller that will give up in 800 ms
+        must not hold this thread for 20 s) and the REMAINING budget
+        travels upstream in the deadline header.
         """
         import requests
 
-        if self.admission.enabled and not self.breaker.allow():
-            self.admission.count_shed("breaker_open")
-            raise UpstreamError(
-                "model tier circuit breaker is open",
-                503,
-                retry_after_s=self.breaker.retry_after_s() or 0.5,
-            )
+        pool = self.pool
+        gate = self.admission.enabled
         body = protocol.encode_predict_request(images)
         # (connect, read) pair: only the READ budget scales with batch size;
         # an unreachable model tier should still fail fast at connect.
-        read_timeout = (
+        base_read = (
             PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(0, images.shape[0] - 1)
         )
-        if deadline is not None:
-            read_timeout = deadline.clamp(read_timeout, floor_s=0.05)
-        timeout = (min(PREDICT_TIMEOUT_S, max(read_timeout, 0.05)), read_timeout)
+        tried: list = []
+        retried_503 = False
+        last_exc: UpstreamError | None = None
         r = None
-        for attempt in (0, 1):
-            if attempt:
-                time.sleep(UPSTREAM_RETRY_BACKOFF_S)
-                if deadline is not None:
-                    # The backoff spent budget; the retry's read must not
-                    # outlive what is left.
-                    read_timeout = deadline.clamp(read_timeout, floor_s=0.05)
-                    timeout = (timeout[0], read_timeout)
-            try:
-                headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
-                if request_id:  # cross-tier trace propagation
-                    headers[REQUEST_ID_HEADER] = request_id
-                if deadline is not None:  # remaining budget, re-measured now
-                    headers[DEADLINE_HEADER] = deadline.header_value()
-                r = self._session().post(
-                    f"{self._base}/v1/models/{self.model}:predict",
-                    data=body,
-                    headers=headers,
-                    timeout=timeout,
+        while True:
+            replica = pool.choose(exclude=tried, gate_breaker=gate)
+            if replica is None:
+                if not tried and gate:
+                    # Every replica refused up front: fast local shed
+                    # instead of a thread-pinning timeout per request.
+                    self.admission.count_shed("breaker_open")
+                    raise UpstreamError(
+                        "model tier circuit breaker is open",
+                        503,
+                        retry_after_s=pool.min_retry_after_s() or 0.5,
+                    )
+                if last_exc is not None:
+                    raise last_exc
+                if r is not None:
+                    raise self._status_error(r)
+                raise UpstreamError(
+                    "no model-tier replica available", 503, retry_after_s=0.5
                 )
-            except requests.RequestException as e:
-                self.breaker.record_failure()
-                raise UpstreamError(f"model server unreachable: {e}") from e
-            # Breaker bookkeeping per attempt: any 5xx (including the
-            # tier's 503 shed) is evidence of an unhealthy/saturated tier;
-            # 2xx-4xx means it is up and judging requests on their merits.
+            if tried and pool.m_failover is not None:
+                pool.m_failover.inc()
+            read_timeout = base_read
+            if deadline is not None:
+                read_timeout = deadline.clamp(read_timeout, floor_s=0.05)
+            timeout = (
+                min(PREDICT_TIMEOUT_S, max(read_timeout, 0.05)), read_timeout
+            )
+            try:
+                self._validate_replica_spec(replica)
+                replica, r = self._post_hedged(
+                    replica, body, request_id, deadline, timeout, tried
+                )
+            except (
+                requests.RequestException,
+                faults_lib.InjectedFault,
+                ConnectionError,
+            ) as e:
+                pool.record_failure(replica)
+                if replica not in tried:
+                    tried.append(replica)
+                last_exc = UpstreamError(f"model server unreachable: {e}")
+                last_exc.__cause__ = e
+                if not pool.failover:
+                    # Blind mode (KDLT_FAILOVER=0, the chaos-A/B baseline
+                    # arm): one attempt, the failure surfaces as-is.
+                    raise last_exc
+                if deadline is not None and (
+                    deadline.remaining_s() < MIN_RETRY_BUDGET_S
+                ):
+                    raise last_exc  # no budget left to try anyone else
+                continue
+            # Breaker/health bookkeeping per attempt: any 5xx (including
+            # the tier's 503 shed) is evidence of an unhealthy/saturated
+            # replica; 2xx-4xx means it is up and judging requests on
+            # their merits.
             if r.status_code >= 500:
-                self.breaker.record_failure()
+                pool.record_failure(replica)
             else:
-                self.breaker.record_success()
+                pool.record_success(replica)
             if r.status_code != 503:
+                break
+            last_exc = None
+            if replica not in tried:
+                tried.append(replica)
+            if pool.has_healthy_candidate(exclude=tried):
+                continue  # overloaded here; another healthy replica may not be
+            if retried_503:
                 break
             if deadline is not None and deadline.remaining_s() < (
                 UPSTREAM_RETRY_BACKOFF_S + MIN_RETRY_BUDGET_S
@@ -282,19 +540,11 @@ class Gateway:
                 # sleeping out the backoff and re-posting work that cannot
                 # finish in time; surface the 503 to the client now.
                 break
+            retried_503 = True
+            time.sleep(UPSTREAM_RETRY_BACKOFF_S)
+            tried.remove(replica)  # the backoff retry re-targets this replica
         if r.status_code != 200:
-            status = 503 if r.status_code == 503 else 502
-            retry_after = None
-            if status == 503:
-                try:
-                    retry_after = float(r.headers.get("Retry-After", ""))
-                except (TypeError, ValueError):
-                    retry_after = None
-            raise UpstreamError(
-                f"model server error {r.status_code}: {r.text[:200]}",
-                status,
-                retry_after_s=retry_after,
-            )
+            raise self._status_error(r)
         try:
             logits, labels = protocol.decode_predict_response(
                 r.content, r.headers.get("Content-Type", "")
@@ -566,6 +816,7 @@ class Gateway:
     def shutdown(self) -> None:
         if self._microbatcher is not None:
             self._microbatcher.close()
+        self.pool.close()
         if self._httpd is None:
             return
         # See ModelServer.shutdown: BaseServer.shutdown() hangs if
@@ -599,6 +850,27 @@ def main(argv: list[str] | None = None) -> int:
         help="disable admission control (deadline rejection, AIMD "
         "concurrency limiting, circuit breaking); graceful drain stays on",
     )
+    p.add_argument(
+        "--no-failover",
+        action="store_true",
+        help="disable upstream failover/health tracking/hedging: the "
+        "replica list becomes a blind round-robin (overrides $KDLT_FAILOVER)",
+    )
+    p.add_argument(
+        "--hedge-delay-ms",
+        type=float,
+        default=None,
+        help="fire a hedged upstream attempt against a second healthy "
+        "replica after this many ms without a response (default "
+        "$KDLT_HEDGE_DELAY_MS; 0 = off)",
+    )
+    p.add_argument(
+        "--probe-interval-s",
+        type=float,
+        default=None,
+        help="seconds between /healthz probes of unhealthy upstream "
+        "replicas (default $KDLT_PROBE_INTERVAL_S or 1.0)",
+    )
     args = p.parse_args(argv)
     gw = Gateway(
         serving_host=args.serving_host,
@@ -608,6 +880,9 @@ def main(argv: list[str] | None = None) -> int:
         upstream_batch=args.upstream_batch,
         upstream_delay_ms=args.upstream_delay_ms,
         admission=False if args.no_admission else None,
+        failover=False if args.no_failover else None,
+        hedge_delay_ms=args.hedge_delay_ms,
+        probe_interval_s=args.probe_interval_s,
     )
     # SIGTERM -> flip /readyz, shed new work, finish in-flight, then stop;
     # pairs with the k8s terminationGracePeriodSeconds/preStop settings.

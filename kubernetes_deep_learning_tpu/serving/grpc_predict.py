@@ -43,7 +43,8 @@ import numpy as np
 
 import grpc
 
-from kubernetes_deep_learning_tpu.runtime import QueueFull
+from kubernetes_deep_learning_tpu.runtime import DispatchStall, QueueFull
+from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 from kubernetes_deep_learning_tpu.serving.tfs_gen.tensorflow.core.framework import (
     tensor_pb2,
 )
@@ -210,9 +211,25 @@ class PredictionServicer:
         status = "INTERNAL"
         self._m_requests.inc()
         try:
+            faults = getattr(self._server, "_faults", None)
+            if faults is not None:
+                # grpc.predict fault point: error -> INTERNAL, disconnect
+                # -> UNAVAILABLE (the gRPC analog of a dropped connection),
+                # latency/hang sleep on the handler thread.
+                faults.fire("grpc.predict")
             resp = impl(request)
             status = "OK"
             return resp
+        except faults_lib.InjectedDisconnect as e:
+            self._m_errors.inc()
+            status = "UNAVAILABLE"
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        except DispatchStall as e:
+            # The engine watchdog failed this dispatch as stuck: retryable
+            # against another replica, terminal for this pod's health.
+            self._m_errors.inc()
+            status = "UNAVAILABLE"
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"dispatch stalled: {e}")
         except KeyError as e:
             self._m_errors.inc()
             status = "NOT_FOUND"

@@ -48,12 +48,14 @@ from kubernetes_deep_learning_tpu.export import artifact as art
 from kubernetes_deep_learning_tpu.runtime import (
     BatcherClosed,
     DispatcherClosed,
+    DispatchStall,
     InferenceEngine,
     InFlightDispatcher,
     QueueFull,
     create_batcher,
     resolve_pipeline_depth,
 )
+from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 from kubernetes_deep_learning_tpu.serving.admission import (
     DEADLINE_HEADER,
     AdaptiveLimiter,
@@ -253,6 +255,11 @@ class ModelServer:
             profile_base = os.path.join(_tf.gettempdir(), "kdlt-traces")
         self._profile_base = profile_base
         self.registry = metrics_lib.Registry()
+        # Fault injection (serving.faults): the server.predict point; None
+        # (zero-overhead) unless $KDLT_FAULTS configures rules.
+        self._faults = faults_lib.from_env()
+        if self._faults is not None:
+            self._faults.attach(self.registry)
         self._m_requests = self.registry.counter(
             "kdlt_server_requests_total", "predict requests"
         )
@@ -315,6 +322,18 @@ class ModelServer:
     @property
     def ready(self) -> bool:
         return all(m.engine.ready for m in self.models.values())
+
+    @property
+    def stalled(self) -> bool:
+        """True once any model's dispatch watchdog declared the in-flight
+        pipeline stuck.  /healthz follows this flag: a wedged device sync
+        cannot be recovered in-process, so the orchestrator must restart
+        the pod (liveness probe failure), while the gateway's replica pool
+        routes around it in the meantime."""
+        return any(
+            m.dispatcher is not None and m.dispatcher.stalled
+            for m in self.models.values()
+        )
 
     # --- version watching --------------------------------------------------
 
@@ -490,6 +509,12 @@ class ModelServer:
             def do_GET(self):
                 self._rid = ""  # keep-alive: never echo a previous POST's id
                 if self.path == "/healthz":
+                    if server.stalled:
+                        # A stalled dispatch pipeline is unrecoverable
+                        # in-process: fail liveness so the orchestrator
+                        # restarts the pod (the watchdog already failed
+                        # the stranded waiters retryably).
+                        return self._send(503, b"dispatch stalled", "text/plain")
                     return self._send(200, b"ok", "text/plain")
                 if self.path == "/readyz":
                     if server.admission.draining:
@@ -497,6 +522,10 @@ class ModelServer:
                         # pool stops routing here while in-flight batches
                         # complete (the gateway has the same semantics).
                         return self._send(503, b"draining", "text/plain")
+                    if server.stalled:
+                        # Readiness too: the Service endpoint pool drops
+                        # this pod faster than the liveness restart lands.
+                        return self._send(503, b"dispatch stalled", "text/plain")
                     if server.ready:
                         return self._send(200, b"ready", "text/plain")
                     return self._send(503, b"warming up", "text/plain")
@@ -560,6 +589,11 @@ class ModelServer:
                     # exhausted or shed request must cost no decode work and
                     # never touch the TPU.
                     ticket = server.admission.admit(deadline)
+                    if server._faults is not None:
+                        # server.predict fault point: error/latency/hang/
+                        # disconnect strike the handler here (admitted, body
+                        # unread); corrupt applies to the response below.
+                        server._faults.fire("server.predict")
                     length = int(self.headers.get("Content-Length", 0))
                     spec = model.artifact.spec
                     # Enforce the byte bound BEFORE reading/decoding: a cap
@@ -603,8 +637,17 @@ class ModelServer:
                     out, out_ctype = protocol.encode_predict_response(
                         logits, spec.labels, ctype
                     )
+                    if server._faults is not None:
+                        out = server._faults.corrupt("server.predict", out)
                     status = 200
                     self._send(200, out, out_ctype)
+                except faults_lib.InjectedDisconnect:
+                    # Injected abrupt connection loss: no response bytes at
+                    # all -- the client sees the socket die mid-request,
+                    # exactly like a crashed replica.
+                    server._m_errors.inc()
+                    status = -1
+                    self.close_connection = True
                 except Shed as e:  # admission refusal, not a fault
                     server._m_errors.inc()
                     status = e.http_status
@@ -621,6 +664,18 @@ class ModelServer:
                     server._m_errors.inc()
                     status = 400
                     self._send_json(400, {"error": str(e)})
+                except DispatchStall as e:
+                    # The engine watchdog declared the dispatch pipeline
+                    # stuck: retryable for the CLIENT (another replica can
+                    # serve it; the gateway's pool fails over on the 503),
+                    # terminal for this pod (/healthz is already failing).
+                    server._m_errors.inc()
+                    status = 503
+                    self._send_json(
+                        503,
+                        {"error": f"dispatch stalled: {e}"},
+                        headers=retry_after_headers(1.0),
+                    )
                 except (QueueFull, FuturesTimeout) as e:  # transient overload
                     server._m_errors.inc()
                     status = 503

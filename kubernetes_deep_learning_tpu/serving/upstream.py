@@ -1,0 +1,284 @@
+"""Multi-replica model-tier upstream pool for the gateway.
+
+PR 2 left the gateway knowing exactly one model-tier address
+(``KDLT_SERVING_HOST``) guarded by one circuit breaker: a dead upstream was
+a fast local 503, but never a *recovery* -- availability was outsourced
+entirely to Kubernetes replica scaling behind one Service VIP, which hides
+per-replica health from the tier that has the per-request context to act
+on it.  This pool makes the gateway itself failure-aware, following "The
+Tail at Scale" (Dean & Barroso, CACM '13):
+
+- ``KDLT_SERVING_HOST`` accepts a comma-separated replica list;
+- per-replica health = passive error tracking (consecutive failures mark a
+  replica unhealthy) + an active ``/healthz`` prober that brings it back,
+  plus a per-replica :class:`CircuitBreaker` (the PR 2 single breaker,
+  generalized);
+- replica selection is round-robin over healthy replicas, falling back to
+  unhealthy ones gated by their breakers (the breaker's half-open probe is
+  the passive recovery path when the active prober is not running);
+- hedge policy state (``KDLT_HEDGE_DELAY_MS``) lives here; the gateway
+  fires the actual hedged HTTP attempts.
+
+``KDLT_FAILOVER=0`` disables all of it (blind round-robin, no health, no
+hedging) -- the A/B baseline arm of ``bench.py --chaos-ab``.
+
+The pool tracks a ``reference_spec``: the first model contract discovered
+from any replica.  Replicas must match it before serving traffic through
+this gateway (checked on first use and re-checked when a replica rejoins
+after being unhealthy), so a replica left serving a different model
+version surfaces as an explicit error, never silently mixed responses.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from kubernetes_deep_learning_tpu.serving.admission import CircuitBreaker
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+HEDGE_DELAY_ENV = "KDLT_HEDGE_DELAY_MS"
+PROBE_INTERVAL_ENV = "KDLT_PROBE_INTERVAL_S"
+FAILOVER_ENV = "KDLT_FAILOVER"
+
+DEFAULT_PROBE_INTERVAL_S = 1.0
+# Consecutive request failures before passive tracking marks a replica
+# unhealthy.  2, not 1: a single failure can be one bad connection in an
+# otherwise healthy replica's pool; two in a row with zero successes
+# between is a pattern worth routing around (the active prober or the
+# breaker's half-open probe brings it back).
+UNHEALTHY_AFTER = 2
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+def parse_hosts(serving_host: str) -> list[str]:
+    """Comma-separated host:port list -> hosts (order preserved, deduped)."""
+    hosts: list[str] = []
+    for h in serving_host.split(","):
+        h = h.strip().rstrip("/")
+        if h and h not in hosts:
+            hosts.append(h)
+    if not hosts:
+        raise ValueError(f"no upstream hosts in {serving_host!r}")
+    return hosts
+
+
+class UpstreamReplica:
+    """One model-tier replica: address + health + breaker + spec cache."""
+
+    def __init__(self, host: str, registry: metrics_lib.Registry | None = None):
+        self.host = host
+        self.base = f"http://{host}"
+        self.breaker = CircuitBreaker()
+        self.healthy = True
+        self.consecutive_failures = 0
+        self.spec = None  # the replica's discovered ModelSpec, lazily fetched
+        self._gauge = (
+            metrics_lib.replica_healthy_gauge(registry, host)
+            if registry is not None
+            else None
+        )
+        if self._gauge is not None:
+            self._gauge.set(1.0)
+
+    def set_healthy(self, healthy: bool) -> None:
+        self.healthy = healthy
+        if self._gauge is not None:
+            self._gauge.set(1.0 if healthy else 0.0)
+
+    def __repr__(self) -> str:  # diagnostics in error messages/logs
+        return f"<replica {self.host} {'up' if self.healthy else 'DOWN'}>"
+
+
+class UpstreamPool:
+    """Replica selection + health accounting for the gateway's upstream hop.
+
+    The pool owns *policy state* (who is healthy, whose breaker allows,
+    hedge delay, probe cadence); the gateway owns the HTTP mechanics.  All
+    selection methods are thread-safe.
+    """
+
+    def __init__(
+        self,
+        hosts: list[str],
+        registry: metrics_lib.Registry | None = None,
+        failover: bool | None = None,
+        hedge_delay_ms: float | None = None,
+        probe_interval_s: float | None = None,
+        unhealthy_after: int = UNHEALTHY_AFTER,
+    ):
+        if failover is None:
+            failover = os.environ.get(FAILOVER_ENV, "").strip() != "0"
+        self.failover = bool(failover)
+        if hedge_delay_ms is None:
+            hedge_delay_ms = _env_float(HEDGE_DELAY_ENV, 0.0)
+        self.hedge_delay_s = max(0.0, hedge_delay_ms) / 1e3
+        if probe_interval_s is None:
+            probe_interval_s = _env_float(
+                PROBE_INTERVAL_ENV, DEFAULT_PROBE_INTERVAL_S
+            )
+        self.probe_interval_s = probe_interval_s
+        self._unhealthy_after = max(1, unhealthy_after)
+        self.replicas = [UpstreamReplica(h, registry) for h in hosts]
+        self.reference_spec = None  # first discovered contract; all must match
+        self._lock = threading.Lock()
+        self._rr = 0
+        m = (
+            metrics_lib.upstream_pool_metrics(registry)
+            if registry is not None
+            else None
+        )
+        self.m_failover = m["failover"] if m else None
+        self.m_hedge_fired = m["hedge_fired"] if m else None
+        self.m_hedge_won = m["hedge_won"] if m else None
+        self._probe_stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+
+    # --- selection ---------------------------------------------------------
+
+    def _rotation(self) -> list[UpstreamReplica]:
+        with self._lock:
+            idx = self._rr
+            self._rr += 1
+        n = len(self.replicas)
+        return [self.replicas[(idx + i) % n] for i in range(n)]
+
+    def choose(
+        self, exclude=(), gate_breaker: bool = True
+    ) -> UpstreamReplica | None:
+        """Pick the next replica to try, or None when every candidate is
+        refused.
+
+        Healthy replicas first (round-robin), then unhealthy ones as a
+        fallback -- their breaker's half-open probe is how a replica
+        recovers when the active prober is not running.  ``gate_breaker``
+        mirrors the admission-enabled posture: each returned candidate
+        consumed a breaker ``allow()`` (half-open probe accounting), so
+        callers MUST follow up with record_success/record_failure.  With
+        failover disabled the pool is a blind round-robin: no health, no
+        breaker, every replica takes its turn dead or alive.
+        """
+        candidates = [r for r in self._rotation() if r not in exclude]
+        if not self.failover:
+            return candidates[0] if candidates else None
+        ordered = [r for r in candidates if r.healthy] + [
+            r for r in candidates if not r.healthy
+        ]
+        for r in ordered:
+            if not gate_breaker or r.breaker.allow():
+                return r
+        return None
+
+    def has_healthy_candidate(self, exclude=()) -> bool:
+        """Non-consuming peek: is failover to a HEALTHY replica possible?
+        (Used to decide immediate-failover vs backoff-retry on a 503;
+        deliberately ignores breakers so it never consumes probe slots.)"""
+        if not self.failover:
+            return False
+        return any(r not in exclude and r.healthy for r in self.replicas)
+
+    def snapshot_ordered(self) -> list[UpstreamReplica]:
+        """Replicas, healthy first (for spec discovery sweeps)."""
+        return [r for r in self.replicas if r.healthy] + [
+            r for r in self.replicas if not r.healthy
+        ]
+
+    # --- accounting --------------------------------------------------------
+
+    def record_failure(self, replica: UpstreamReplica) -> None:
+        with self._lock:
+            replica.consecutive_failures += 1
+            if (
+                replica.consecutive_failures >= self._unhealthy_after
+                and replica.healthy
+            ):
+                replica.set_healthy(False)
+        replica.breaker.record_failure()
+
+    def record_success(self, replica: UpstreamReplica) -> None:
+        with self._lock:
+            replica.consecutive_failures = 0
+            if not replica.healthy:
+                replica.set_healthy(True)
+        replica.breaker.record_success()
+
+    def mark_spec_mismatch(self, replica: UpstreamReplica) -> None:
+        """Route around a replica serving a different model contract.  Its
+        cached (mismatching) spec is kept: only a health-state rejoin
+        (probe success) clears it for re-validation, so a permanently
+        wrong replica stays out instead of flapping per request."""
+        with self._lock:
+            replica.set_healthy(False)
+
+    def min_retry_after_s(self) -> float:
+        """Smallest positive breaker cool-down across replicas (0 if none):
+        the soonest any upstream might accept work again."""
+        waits = [r.breaker.retry_after_s() for r in self.replicas]
+        positive = [w for w in waits if w > 0]
+        return min(positive) if positive else 0.0
+
+    # --- active probing ----------------------------------------------------
+
+    def start_probing(self) -> None:
+        """Start the /healthz prober (daemon); no-op for a single replica,
+        with failover disabled, or a non-positive interval."""
+        if (
+            self._probe_thread is not None
+            or not self.failover
+            or len(self.replicas) < 2
+            or self.probe_interval_s <= 0
+        ):
+            return
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="kdlt-upstream-prober", daemon=True
+        )
+        self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 - the prober must never die
+                pass
+
+    def probe_once(self) -> None:
+        """GET /healthz on every UNHEALTHY replica; a 200 rejoins it.
+
+        Healthy replicas are left alone -- live traffic is their probe.
+        Rejoin resets the breaker (the probe IS the recovery evidence;
+        waiting out the breaker cool-down on top would stretch recovery
+        past one probe interval) and drops the cached spec so the
+        contract is re-validated before the replica serves again.
+        """
+        import requests
+
+        timeout = min(1.0, max(0.1, self.probe_interval_s))
+        for r in self.replicas:
+            if r.healthy:
+                continue
+            try:
+                ok = (
+                    requests.get(f"{r.base}/healthz", timeout=timeout).status_code
+                    == 200
+                )
+            except requests.RequestException:
+                ok = False
+            if ok:
+                with self._lock:
+                    r.consecutive_failures = 0
+                    r.spec = None
+                    r.set_healthy(True)
+                r.breaker.reset()
+
+    def close(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None

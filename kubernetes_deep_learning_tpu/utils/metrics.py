@@ -124,6 +124,45 @@ def admission_metrics(registry: "Registry") -> dict:
     }
 
 
+# Serving-path fault tolerance (serving.upstream, serving.faults, the
+# dispatcher watchdog).  Centralized like the helpers above so the gateway
+# pool, the model tier, and bench.py --chaos-ab emit the SAME series names.
+
+
+def upstream_pool_metrics(registry: "Registry") -> dict:
+    """The gateway-tier replica-pool series (failover + hedging)."""
+    return {
+        "failover": registry.counter(
+            "kdlt_upstream_failover_total",
+            "upstream attempts redirected to another replica after a failure",
+        ),
+        "hedge_fired": registry.counter(
+            "kdlt_hedge_fired_total",
+            "hedged second attempts fired after the hedge delay",
+        ),
+        "hedge_won": registry.counter(
+            "kdlt_hedge_won_total",
+            "hedged attempts whose response was the one used",
+        ),
+    }
+
+
+def replica_healthy_gauge(registry: "Registry", host: str) -> "Gauge":
+    """Per-replica health gauge (1 = routable, 0 = routed around)."""
+    return registry.with_labels(replica=host).gauge(
+        "kdlt_upstream_replica_healthy",
+        "1 while the upstream replica is considered healthy",
+    )
+
+
+def dispatch_stall_counter(registry: "Registry") -> "Counter":
+    """In-flight dispatch handles the watchdog declared stuck and failed."""
+    return registry.counter(
+        "kdlt_dispatch_stall_total",
+        "in-flight dispatches failed by the engine watchdog as stuck",
+    )
+
+
 def _fmt_labels(labels: dict[str, str] | None, extra: str = "") -> str:
     parts = [f'{k}="{v}"' for k, v in (labels or {}).items()]
     if extra:

@@ -127,6 +127,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--host-saturation", "5"], "host_saturation"),
         (["--batcher-sweep", "5"], "batcher_sweep"),
         (["--overload-ab", "6"], "overload_ab"),
+        (["--chaos-ab", "6"], "chaos_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -157,6 +158,25 @@ def test_dry_run_overload_ab_echoes_the_admission_config():
     assert out["overload"]["rate_x"] == 3.0
     assert out["overload"]["buckets"] == [1, 2]
     assert out["overload"]["device_ms"] == 100.0
+
+
+def test_dry_run_chaos_ab_echoes_the_fault_tolerance_config():
+    # The --chaos-ab invocation surface (the serving-path fault-tolerance
+    # acceptance harness) must keep parsing and echo its resolved knobs.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--chaos-ab", "6", "--dry-run",
+         "--chaos-hedge-ms", "80", "--chaos-probe-s", "0.25",
+         "--chaos-seed", "7"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "chaos_ab"
+    assert out["chaos"]["hedge_ms"] == 80.0
+    assert out["chaos"]["probe_s"] == 0.25
+    assert out["chaos"]["seed"] == 7
+    assert out["chaos"]["deadline_ms"] == 2000.0
 
 
 # --- the pipelined-vs-serial A/B acceptance bound -------------------------

@@ -158,6 +158,86 @@ def test_k8s_and_compose_drain_semantics():
         )
 
 
+def test_k8s_model_tier_replicated_for_failover():
+    """The serving-path fault-tolerance wiring (serving/upstream.py): the
+    model tier runs >= 2 replicas with stable per-pod DNS (StatefulSet +
+    headless Service), the gateway's KDLT_SERVING_HOST names each replica
+    individually, and the hedge/probe knobs are set."""
+    from kubernetes_deep_learning_tpu.serving.gateway import SERVING_HOST_ENV
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        DEFAULT_PORT as MODEL_PORT,
+    )
+    from kubernetes_deep_learning_tpu.serving.upstream import (
+        HEDGE_DELAY_ENV,
+        PROBE_INTERVAL_ENV,
+        parse_hosts,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (model_svc,) = _yaml_docs(os.path.join(k8s, "model-server-service.yaml"))
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+
+    assert model_dep["spec"]["replicas"] >= 2, (
+        "failover needs a survivor: the model tier must run >= 2 replicas"
+    )
+    # Stable per-replica DNS requires a StatefulSet behind a headless Service.
+    assert model_dep["kind"] == "StatefulSet"
+    assert model_dep["spec"]["serviceName"] == model_svc["metadata"]["name"]
+    assert model_svc["spec"].get("clusterIP") is None or (
+        model_svc["spec"]["clusterIP"] == "None"
+    )
+
+    gw_container = gw_dep["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value", "") for e in gw_container.get("env", [])}
+    hosts = parse_hosts(env[SERVING_HOST_ENV])
+    assert len(hosts) >= model_dep["spec"]["replicas"], (
+        f"{SERVING_HOST_ENV} must list every model-tier replica, got {hosts}"
+    )
+    set_name = model_dep["metadata"]["name"]
+    for i, host in enumerate(hosts):
+        # StatefulSet pod DNS: <name>-<ordinal>.<headless-svc>...:<port>
+        assert host.startswith(f"{set_name}-{i}."), host
+        assert host.endswith(str(MODEL_PORT)), host
+    assert float(env[HEDGE_DELAY_ENV]) > 0, "hedging must be wired on"
+    assert float(env[PROBE_INTERVAL_ENV]) > 0, "active probing must be on"
+
+    # Readiness tuned for failover: with a survivor carrying the tier,
+    # eviction latency IS failover latency -- a dead replica must leave the
+    # endpoint pool within a few seconds.
+    model_container = model_dep["spec"]["template"]["spec"]["containers"][0]
+    probe = model_container["readinessProbe"]
+    assert probe["periodSeconds"] * probe["failureThreshold"] <= 6, (
+        "readiness eviction must complete within a few seconds for failover"
+    )
+
+
+def test_compose_has_second_model_replica_wired_for_failover():
+    """docker-compose: two model-server replicas, the gateway's
+    KDLT_SERVING_HOST listing both, hedging configured -- the compose-local
+    topology bench.py --chaos-ab models."""
+    from kubernetes_deep_learning_tpu.serving.gateway import SERVING_HOST_ENV
+    from kubernetes_deep_learning_tpu.serving.upstream import (
+        HEDGE_DELAY_ENV,
+        parse_hosts,
+    )
+
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    services = compose["services"]
+    gw_env = services["gateway"]["environment"]
+    hosts = parse_hosts(str(gw_env[SERVING_HOST_ENV]))
+    assert len(hosts) >= 2, "gateway must be wired with a replica list"
+    model_services = [h.split(":")[0] for h in hosts]
+    for name in model_services:
+        assert name in services, f"replica list names unknown service {name!r}"
+        # Every listed replica is a model-server build with a healthcheck
+        # (the gateway's depends_on gates on it).
+        assert "model-server" in services[name]["build"]["dockerfile"]
+        assert "healthcheck" in services[name]
+        assert name in services["gateway"]["depends_on"]
+    assert float(gw_env[HEDGE_DELAY_ENV]) > 0
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

@@ -1,0 +1,245 @@
+"""The fault-injection framework (serving.faults): parsing, determinism,
+inertness when unset, each fault kind's behavior, and the kinds wired
+through the real model server handler (error -> 500, disconnect -> dropped
+connection, corrupt -> undecodable response, all counted in
+kdlt_fault_injected_total)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+from kubernetes_deep_learning_tpu.serving import faults, protocol
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+
+# --- parsing ----------------------------------------------------------------
+
+
+def test_parse_rules_full_syntax():
+    rules = faults.parse_rules(
+        "gateway.upstream:error:0.5,server.predict:latency:1.0:25"
+    )
+    assert rules == (
+        faults.FaultRule("gateway.upstream", "error", 0.5, None),
+        faults.FaultRule("server.predict", "latency", 1.0, 25.0),
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "point:explode:1.0",     # unknown kind
+        "point:error:1.5",       # rate out of range
+        "point:error",           # missing rate
+        ":error:1.0",            # empty point
+        "point:error:notafloat",
+    ],
+)
+def test_parse_rules_rejects_garbage(bad):
+    # A typo'd chaos experiment must fail loudly, not silently run healthy.
+    with pytest.raises(ValueError):
+        faults.parse_rules(bad)
+
+
+def test_from_env_inert_when_unset(monkeypatch):
+    monkeypatch.delenv(faults.FAULTS_ENV, raising=False)
+    assert faults.from_env() is None
+    monkeypatch.setenv(faults.FAULTS_ENV, "   ")
+    assert faults.from_env() is None
+    monkeypatch.setenv(faults.FAULTS_ENV, "p:error:1.0")
+    assert faults.from_env() is not None
+
+
+# --- determinism ------------------------------------------------------------
+
+
+def _fire_pattern(injector, point, n=64):
+    out = []
+    for _ in range(n):
+        try:
+            injector.fire(point)
+            out.append(0)
+        except faults.InjectedFault:
+            out.append(1)
+    return out
+
+
+def test_same_seed_same_fault_sequence():
+    rules = faults.parse_rules("p:error:0.3")
+    a = faults.FaultInjector(rules, seed=7)
+    b = faults.FaultInjector(rules, seed=7)
+    pattern = _fire_pattern(a, "p")
+    assert pattern == _fire_pattern(b, "p")
+    assert 0 < sum(pattern) < len(pattern)  # rate 0.3 actually samples
+
+
+def test_per_point_streams_independent_of_interleaving():
+    # Firing point q between p's arrivals must not change p's pattern.
+    rules = faults.parse_rules("p:error:0.3,q:error:0.3")
+    a = faults.FaultInjector(rules, seed=1)
+    b = faults.FaultInjector(rules, seed=1)
+    pattern_a = _fire_pattern(a, "p")
+    pattern_b = []
+    for _ in range(64):
+        _fire_pattern(b, "q", n=3)  # interleaved q arrivals
+        pattern_b.extend(_fire_pattern(b, "p", n=1))
+    assert pattern_a == pattern_b
+
+
+def test_rate_bounds():
+    always = faults.FaultInjector(faults.parse_rules("p:error:1.0"))
+    with pytest.raises(faults.InjectedFault):
+        always.fire("p")
+    never = faults.FaultInjector(faults.parse_rules("p:error:0.0"))
+    for _ in range(100):
+        never.fire("p")
+    assert never.counts[("p", "error")] == 0
+    # Unconfigured points are free.
+    always.fire("other.point")
+
+
+# --- each kind --------------------------------------------------------------
+
+
+def test_kind_latency_sleeps():
+    inj = faults.FaultInjector(faults.parse_rules("p:latency:1.0:30"))
+    t0 = time.perf_counter()
+    inj.fire("p")
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_kind_hang_sleeps_arg_seconds():
+    inj = faults.FaultInjector(faults.parse_rules("p:hang:1.0:0.05"))
+    t0 = time.perf_counter()
+    inj.fire("p")
+    assert time.perf_counter() - t0 >= 0.045
+
+
+def test_kind_disconnect_raises_connection_error():
+    inj = faults.FaultInjector(faults.parse_rules("p:disconnect:1.0"))
+    with pytest.raises(faults.InjectedDisconnect):
+        inj.fire("p")
+    assert issubclass(faults.InjectedDisconnect, ConnectionError)
+
+
+def test_kind_corrupt_garbles_payload_only_when_firing():
+    data = bytes(range(200))
+    inj = faults.FaultInjector(faults.parse_rules("p:corrupt:1.0"))
+    garbled = inj.corrupt("p", data)
+    assert garbled != data and len(garbled) == len(data)
+    # fire() ignores corrupt rules (they only apply to payloads).
+    inj.fire("p")
+    off = faults.FaultInjector(faults.parse_rules("p:corrupt:0.0"))
+    assert off.corrupt("p", data) == data
+
+
+# --- wired through the real model server ------------------------------------
+
+
+def _stub_server(name, tmp_path, **kw):
+    spec = register_spec(
+        ModelSpec(
+            name=name,
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    root = tmp_path / "models"
+    art.save_artifact(
+        art.version_dir(str(root), spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        str(root), port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=StubEngine, **kw,
+    )
+    server.warmup()
+    server.start()
+    return spec, server
+
+
+def _post(spec, server, n=1, timeout=10.0):
+    import requests
+
+    img = np.zeros((n, *spec.input_shape), np.uint8)
+    return requests.post(
+        f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict",
+        data=protocol.encode_predict_request(img),
+        headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+        timeout=timeout,
+    )
+
+
+def test_server_without_faults_env_is_inert(tmp_path, monkeypatch):
+    monkeypatch.delenv(faults.FAULTS_ENV, raising=False)
+    spec, server = _stub_server("faults-inert", tmp_path)
+    try:
+        assert server._faults is None
+        assert _post(spec, server).status_code == 200
+    finally:
+        server.shutdown()
+
+
+def test_server_error_fault_becomes_500_and_is_counted(tmp_path, monkeypatch):
+    import requests
+
+    monkeypatch.setenv(faults.FAULTS_ENV, "server.predict:error:1.0")
+    spec, server = _stub_server("faults-error", tmp_path)
+    try:
+        r = _post(spec, server)
+        assert r.status_code == 500
+        assert "injected fault" in r.json()["error"]
+        metrics = requests.get(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).text
+        assert (
+            'kdlt_fault_injected_total{point="server.predict",kind="error"} 1'
+            in metrics
+        )
+    finally:
+        server.shutdown()
+
+
+def test_server_disconnect_fault_drops_connection(tmp_path, monkeypatch):
+    import requests
+
+    monkeypatch.setenv(faults.FAULTS_ENV, "server.predict:disconnect:1.0")
+    spec, server = _stub_server("faults-disc", tmp_path)
+    try:
+        with pytest.raises(requests.RequestException):
+            _post(spec, server)
+    finally:
+        server.shutdown()
+
+
+def test_server_corrupt_fault_makes_response_undecodable(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV, "server.predict:corrupt:1.0")
+    spec, server = _stub_server("faults-corrupt", tmp_path)
+    try:
+        r = _post(spec, server)
+        # The status is still 200 -- corruption is a payload fault, which is
+        # exactly why the gateway must decode defensively (502, not silence).
+        assert r.status_code == 200
+        with pytest.raises(Exception):
+            protocol.decode_predict_response(
+                r.content, r.headers.get("Content-Type", "")
+            )
+    finally:
+        server.shutdown()
+
+
+def test_server_latency_fault_delays_requests(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV, "server.predict:latency:1.0:80")
+    spec, server = _stub_server("faults-lat", tmp_path)
+    try:
+        t0 = time.perf_counter()
+        assert _post(spec, server).status_code == 200
+        assert time.perf_counter() - t0 >= 0.07
+    finally:
+        server.shutdown()
