@@ -1637,6 +1637,154 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
     return out, 0 if ok else 1
 
 
+def bench_trace_breakdown(n_requests=30, device_ms=60.0, deadline_ms=5000.0,
+                          max_delay_ms=1.0):
+    """Span-trace latency attribution on a stub serving stack.
+
+    A REAL gateway fronts a stub-backed ModelServer (async stub device:
+    the in-flight dispatch pipeline and its four stage spans engage); a
+    sequential client sends traced /predict requests and, for each, pulls
+    the merged cross-tier waterfall from the gateway's /debug/trace/<rid>.
+    Per-stage p50/p99 come from the span durations; **coverage** is the
+    fraction of each request's measured wall time attributed to named
+    spans (the gateway root span over the client-observed latency).
+
+    Returns (json_dict, rc); rc=0 iff mean coverage >= 0.95 AND every
+    request's waterfall has >= 8 spans -- the tracing layer's acceptance
+    bar: if the spans cannot account for where a stub request's time
+    went, they will not account for a real one's either.
+    """
+    import tempfile
+    import threading
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    import requests
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+    from kubernetes_deep_learning_tpu.serving.tracing import REQUEST_ID_HEADER
+
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    spec = register_spec(
+        ModelSpec(
+            name="trace-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    rng = np.random.default_rng(0)
+    img_dir = tempfile.mkdtemp(prefix="kdlt-trace-img-")
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(img_dir, "img.png"))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    img_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+
+    root = tempfile.mkdtemp(prefix="kdlt-trace-bd-")
+    art.save_artifact(
+        art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        root, port=0, buckets=(1, 2), max_delay_ms=max_delay_ms,
+        host="127.0.0.1", batcher_impl="python",
+        engine_factory=lambda a, **kw: StubEngine(
+            a, device_ms_per_batch=device_ms, async_device=True, **kw
+        ),
+    )
+    server.warmup()
+    server.start()
+    gateway = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name, port=0,
+        host="127.0.0.1",
+    )
+    gateway.start()
+    log(
+        f"trace breakdown: stub stack ({device_ms}ms device/batch), "
+        f"{n_requests} sequential traced requests"
+    )
+    session = requests.Session()
+    base = f"http://127.0.0.1:{gateway.port}"
+    # One untimed warmup request: spec discovery, connection setup, and the
+    # stub's first dispatch are one-time costs, not steady-state breakdown.
+    session.post(base + "/predict", json={"url": img_url}, timeout=30)
+
+    stage_ms: dict[str, list[float]] = {}
+    coverage: list[float] = []
+    span_counts: list[int] = []
+    try:
+        for i in range(n_requests):
+            rid = f"trace-bd-{i}"
+            t0 = time.monotonic()
+            r = session.post(
+                base + "/predict", json={"url": img_url},
+                headers={
+                    REQUEST_ID_HEADER: rid,
+                    DEADLINE_HEADER: f"{deadline_ms:.1f}",
+                },
+                timeout=30,
+            )
+            wall_s = time.monotonic() - t0
+            r.raise_for_status()
+            tr = session.get(base + f"/debug/trace/{rid}", timeout=5)
+            tr.raise_for_status()
+            spans = tr.json()["spans"]
+            span_counts.append(len(spans))
+            root_dur_ms = 0.0
+            for s in spans:
+                stage_ms.setdefault(s["name"], []).append(s["dur_ms"])
+                if s["name"] == "gateway.request":
+                    root_dur_ms = s["dur_ms"]
+            coverage.append(min(1.0, root_dur_ms / 1e3 / max(wall_s, 1e-9)))
+    finally:
+        gateway.shutdown()
+        server.shutdown()
+        img_httpd.shutdown()
+
+    stages = {
+        name: {
+            "p50_ms": round(float(np.percentile(durs, 50)), 2),
+            "p99_ms": round(float(np.percentile(durs, 99)), 2),
+            "n": len(durs),
+        }
+        for name, durs in sorted(stage_ms.items())
+    }
+    mean_cov = float(np.mean(coverage)) if coverage else 0.0
+    min_spans = min(span_counts) if span_counts else 0
+    for name, st in stages.items():
+        log(f"  {name:<24s} p50 {st['p50_ms']:8.2f} ms  p99 {st['p99_ms']:8.2f} ms")
+    log(
+        f"  coverage: mean {mean_cov:.3f} of client wall attributed to "
+        f"named spans; min spans/request {min_spans}"
+    )
+    ok = mean_cov >= 0.95 and min_spans >= 8
+    out = {
+        "metric": (
+            "span-trace breakdown (stub stack): fraction of client-"
+            "measured request wall time attributed to named spans; "
+            "per-stage p50/p99 from the merged waterfall"
+        ),
+        "value": round(mean_cov, 4),
+        "unit": "fraction of wall time attributed",
+        "requests": n_requests,
+        "device_ms": device_ms,
+        "min_spans_per_request": min_spans,
+        "stages": stages,
+    }
+    return out, 0 if ok else 1
+
+
 def bench_host_saturation(duration_s, clients, batch_sizes, batcher_impl,
                           max_delay_ms, stub_device_ms=0.0):
     """Can the HTTP + protocol + batcher host path carry the target WITHOUT
@@ -2008,6 +2156,18 @@ def main() -> int:
         help="deterministic seed for the --chaos-ab request schedule",
     )
     p.add_argument(
+        "--trace-breakdown", type=int, default=0, metavar="N",
+        help="INSTEAD of the sweep: send N traced requests through a stub "
+             "gateway->model-server stack and attribute each request's "
+             "wall time to named spans from /debug/trace/<rid> (per-stage "
+             "p50/p99 + coverage; rc=0 iff >=95%% of wall time is "
+             "attributed and every waterfall has >=8 spans)",
+    )
+    p.add_argument(
+        "--trace-device-ms", type=float, default=60.0,
+        help="simulated device ms per batch for --trace-breakdown",
+    )
+    p.add_argument(
         "--dry-run", action="store_true",
         help="parse arguments, echo the resolved run configuration as one "
              "JSON line, and exit 0 -- a CI smoke so bench refactors can "
@@ -2057,7 +2217,8 @@ def main() -> int:
         # line; no jax import, no device dial, no subprocesses.
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "batcher_sweep",
-                     "host_saturation", "overload_ab", "chaos_ab"):
+                     "host_saturation", "overload_ab", "chaos_ab",
+                     "trace_breakdown"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -2086,6 +2247,10 @@ def main() -> int:
                 "hedge_ms": args.chaos_hedge_ms,
                 "probe_s": args.chaos_probe_s,
                 "seed": args.chaos_seed,
+            },
+            "trace": {
+                "requests": args.trace_breakdown,
+                "device_ms": args.trace_device_ms,
             },
         }), flush=True)
         return 0
@@ -2153,6 +2318,14 @@ def main() -> int:
             hedge_delay_ms=args.chaos_hedge_ms,
             probe_interval_s=args.chaos_probe_s,
             seed=args.chaos_seed,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.trace_breakdown > 0:
+        out, rc = bench_trace_breakdown(
+            n_requests=args.trace_breakdown,
+            device_ms=args.trace_device_ms,
         )
         print(json.dumps(out), flush=True)
         return rc

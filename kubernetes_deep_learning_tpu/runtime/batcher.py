@@ -36,6 +36,7 @@ from kubernetes_deep_learning_tpu.runtime.engine import (
     resolve_pipeline_depth,
 )
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 
 class BatcherClosed(RuntimeError):
@@ -92,8 +93,14 @@ class DynamicBatcher:
         self._thread = threading.Thread(target=self._run, name="kdlt-batcher", daemon=True)
         self._thread.start()
 
-    def submit(self, image: np.ndarray) -> Future:
-        """Enqueue one HWC uint8 image; resolves to its logits row."""
+    def submit(self, image: np.ndarray, trace=None) -> Future:
+        """Enqueue one HWC uint8 image; resolves to its logits row.
+
+        ``trace`` (utils.trace.RequestTrace, optional) attributes this
+        request's share of the batch pipeline on its waterfall: a
+        ``batcher.queue_wait`` span for the time spent coalescing, then
+        the dispatcher's four pipeline-stage spans.
+        """
         image = np.asarray(image)
         expected = getattr(getattr(self._engine, "spec", None), "input_shape", None)
         if expected is not None and tuple(image.shape) != tuple(expected):
@@ -105,25 +112,28 @@ class DynamicBatcher:
             # uint8 rows would skip normalization; keep the batcher single-dtype.
             raise ValueError(f"batcher takes uint8 images, got {image.dtype}")
         fut: Future = Future()
+        enq_w = trace_lib.now_s() if trace is not None else 0.0
         with self._cond:
             if self._closed:
                 raise BatcherClosed("batcher is shut down")
             if len(self._queue) >= self.queue_cap:
                 self._m_queue_full.inc()
                 raise QueueFull("request queue full")
-            self._queue.append((image, fut))
+            self._queue.append((image, fut, trace, enq_w))
             self._cond.notify()
         return fut
 
-    def predict(self, image: np.ndarray, timeout: float = 20.0) -> np.ndarray:
+    def predict(
+        self, image: np.ndarray, timeout: float = 20.0, trace=None
+    ) -> np.ndarray:
         """Blocking single-image predict (the gateway's call).
 
         Default timeout mirrors the reference's 20 s gRPC deadline
         (reference model_server.py:55).
         """
-        return self.submit(image).result(timeout=timeout)
+        return self.submit(image, trace=trace).result(timeout=timeout)
 
-    def _take_batch(self) -> list[tuple[np.ndarray, Future]]:
+    def _take_batch(self) -> list[tuple]:
         with self._cond:
             while not self._queue and not self._closed:
                 self._cond.wait()
@@ -146,6 +156,15 @@ class DynamicBatcher:
             if not batch:
                 return  # closed and drained
             self._m_batch_size.observe(len(batch))
+            taken_w = 0.0
+            traces = [tr for _, _, tr, _ in batch if tr is not None]
+            if traces:
+                # Queue-wait span per member: enqueue -> batch assembly.
+                taken_w = trace_lib.now_s()
+                for _, _, tr, enq_w in batch:
+                    if tr is not None:
+                        tr.record("batcher.queue_wait", enq_w, taken_w - enq_w,
+                                  batch=len(batch))
             if self._dispatcher is not None:
                 # Pipelined path: enqueue and IMMEDIATELY go assemble the
                 # next batch -- its gather/stack overlaps this batch's
@@ -153,10 +172,10 @@ class DynamicBatcher:
                 # (blocks at the in-flight depth limit); the dispatcher's
                 # completion thread runs _publish via the done callback.
                 try:
-                    images = np.stack([img for img, _ in batch])
-                    fut_batch = self._dispatcher.submit(images)
+                    images = np.stack([img for img, _, _, _ in batch])
+                    fut_batch = self._dispatcher.submit(images, traces=traces)
                 except Exception as e:  # closed dispatcher / bad batch
-                    for _, fut in batch:
+                    for _, fut, _, _ in batch:
                         if not fut.cancelled():
                             fut.set_exception(e)
                     continue
@@ -165,14 +184,19 @@ class DynamicBatcher:
                 )
                 continue
             try:
-                images = np.stack([img for img, _ in batch])
+                images = np.stack([img for img, _, _, _ in batch])
                 logits = self._engine.predict(images)
             except Exception as e:  # propagate to all waiters, keep serving
-                for _, fut in batch:
+                for _, fut, _, _ in batch:
                     if not fut.cancelled():
                         fut.set_exception(e)
                 continue
-            for i, (_, fut) in enumerate(batch):
+            if traces:
+                done_w = trace_lib.now_s()
+                for tr in traces:
+                    tr.record("engine.predict", taken_w, done_w - taken_w,
+                              batch=len(batch))
+            for i, (_, fut, _, _) in enumerate(batch):
                 if not fut.cancelled():
                     fut.set_result(logits[i])
 
@@ -183,12 +207,12 @@ class DynamicBatcher:
         raise (it would kill result delivery for later batches)."""
         exc = fut_batch.exception()
         if exc is not None:
-            for _, fut in batch:
+            for _, fut, _, _ in batch:
                 if not fut.cancelled():
                     fut.set_exception(exc)
             return
         logits = fut_batch.result()
-        for i, (_, fut) in enumerate(batch):
+        for i, (_, fut, _, _) in enumerate(batch):
             if not fut.cancelled():
                 fut.set_result(logits[i])
 
@@ -198,7 +222,7 @@ class DynamicBatcher:
             if not drain:
                 pending = self._queue[:]
                 self._queue.clear()
-                for _, fut in pending:
+                for _, fut, _, _ in pending:
                     fut.set_exception(BatcherClosed("batcher shut down"))
             self._cond.notify_all()
         self._thread.join(timeout=30.0)

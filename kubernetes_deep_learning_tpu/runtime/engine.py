@@ -36,6 +36,7 @@ import numpy as np
 
 from kubernetes_deep_learning_tpu.export.artifact import ModelArtifact
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
@@ -195,18 +196,27 @@ class InFlightDispatcher:
         dispatcher no longer accepts work and serving health should fail."""
         return self._stalled.is_set()
 
-    def submit(self, images: np.ndarray) -> Future:
+    def submit(self, images: np.ndarray, traces=()) -> Future:
         """Dispatch one uint8 batch; returns a Future of its logits rows.
 
         Blocks only while ``depth`` batches are in flight (backpressure) --
         never on device execution of the batch itself.
+
+        ``traces`` carries the member requests' utils.trace.RequestTrace
+        objects (one per coalesced request; the batchers pass theirs
+        through).  Each member's waterfall gets the four pipeline-stage
+        spans -- the exact boundaries that feed kdlt_pipeline_*_seconds --
+        recorded at completion, so a slow request shows WHICH stage of its
+        batch ate the time, not just that the batch was slow.
         """
         if self._stalled.is_set():
             # The completion thread is wedged on a sync that never returns;
             # slots will never free, so blocking on one would hang the
             # caller.  Fail fast and retryably (another replica can serve).
             raise DispatchStall("dispatch pipeline is stalled")
+        traces = tuple(t for t in traces if t is not None)
         t0 = time.perf_counter()
+        w0 = trace_lib.now_s() if traces else 0.0
         self._slots.acquire()
         if self._closed:
             self._slots.release()
@@ -215,6 +225,7 @@ class InFlightDispatcher:
             self._slots.release()
             raise DispatchStall("dispatch pipeline is stalled")
         self._m_stage["enqueue_wait"].observe(time.perf_counter() - t0)
+        w1 = trace_lib.now_s() if traces else 0.0
         fut: Future = Future()
         t1 = time.perf_counter()
         try:
@@ -227,11 +238,14 @@ class InFlightDispatcher:
             return fut
         self._m_stage["dispatch"].observe(time.perf_counter() - t1)
         dispatched_at = time.perf_counter()
+        w2 = trace_lib.now_s() if traces else 0.0
         with self._inflight_lock:
             token = self._seq
             self._seq += 1
             self._inflight[token] = (fut, n, dispatched_at)
-        self._completions.put((handle, n, fut, dispatched_at, token))
+        self._completions.put(
+            (handle, n, fut, dispatched_at, token, traces, (w0, w1, w2))
+        )
         return fut
 
     def _complete_loop(self) -> None:
@@ -242,11 +256,13 @@ class InFlightDispatcher:
             self._complete_one(*item)
 
     def _complete_one(
-        self, handle, n: int, fut: Future, dispatched_at: float, token: int
+        self, handle, n: int, fut: Future, dispatched_at: float, token: int,
+        traces=(), walls=(0.0, 0.0, 0.0),
     ) -> None:
         """MUST NOT raise: an exception escaping here kills the completion
         thread, which strands every later batch's waiters AND deadlocks
         close() -- so anything unexpected fails THIS future instead."""
+        w3 = trace_lib.now_s() if traces else 0.0
         t0 = time.perf_counter()
         try:
             if self._faults is not None:
@@ -273,6 +289,22 @@ class InFlightDispatcher:
                 self._engine.record_completed(n, t1 - dispatched_at)
         except Exception:  # noqa: BLE001 - accounting must not stall results
             pass
+        if traces:
+            # Per-member pipeline-stage spans from the SHARED perf-counter
+            # boundaries (one batch, one set of intervals): exactly
+            # contiguous and non-overlapping in every member's waterfall.
+            # Recorded BEFORE the future resolves so a handler that sends
+            # its response right after result() always finds them.
+            w0, w1, w2 = walls
+            w4 = w3 + (t1 - t0)
+            try:
+                for tr in traces:
+                    tr.record("pipeline.enqueue_wait", w0, w1 - w0)
+                    tr.record("pipeline.dispatch", w1, w2 - w1)
+                    tr.record("pipeline.execute", w2, w3 - w2)
+                    tr.record("pipeline.readback", w3, w4 - w3)
+            except Exception:  # noqa: BLE001 - tracing must not stall results
+                pass
         self._slots.release()
         try:
             if not fut.cancelled():

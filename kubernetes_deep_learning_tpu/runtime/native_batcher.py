@@ -204,9 +204,19 @@ class NativeBatcher:
 
     # --- request side ------------------------------------------------------
 
-    def predict(self, image: np.ndarray, timeout: float = 20.0) -> np.ndarray:
+    def predict(
+        self, image: np.ndarray, timeout: float = 20.0, trace=None
+    ) -> np.ndarray:
         """Blocking single-image predict (the reference's 20 s deadline,
-        reference model_server.py:55)."""
+        reference model_server.py:55).
+
+        ``trace`` (utils.trace.RequestTrace, optional) records ONE coarse
+        ``batcher.wait`` span covering queue + dispatch + execute +
+        readback: the C++ ticket queue cannot carry per-request Python
+        objects through to the dispatch loop, so the native path trades
+        per-stage attribution for its GIL-free hot path (the Python
+        batcher gives the full stage breakdown).
+        """
         if self._closed:
             raise BatcherClosed("batcher is shut down")
         image = np.ascontiguousarray(image)
@@ -225,9 +235,18 @@ class NativeBatcher:
         if ticket == -2:
             raise BatcherClosed("batcher is shut down")
         out = np.empty(self._out_floats, np.float32)
-        rc = self._lib.kdlt_bq_wait(
-            self._q, ticket, out.ctypes.data_as(f32p), timeout
-        )
+        if trace is not None:
+            from kubernetes_deep_learning_tpu.utils import trace as trace_lib
+
+            w0 = trace_lib.now_s()
+            rc = self._lib.kdlt_bq_wait(
+                self._q, ticket, out.ctypes.data_as(f32p), timeout
+            )
+            trace.record("batcher.wait", w0, trace_lib.now_s() - w0, rc=rc)
+        else:
+            rc = self._lib.kdlt_bq_wait(
+                self._q, ticket, out.ctypes.data_as(f32p), timeout
+            )
         if rc == 0:
             return out
         if rc == 1:

@@ -88,6 +88,16 @@ def predict_url(
             continue
         if r.status_code != 503 or attempt >= retries:
             r.raise_for_status()
+            # The served request's trace handles: the echoed request id
+            # (= trace id, the /debug/trace/<rid> key) and this tier's
+            # span summary header -- the CLI's --trace mode uses both.
+            from kubernetes_deep_learning_tpu.serving.tracing import (
+                REQUEST_ID_HEADER,
+                TRACE_HEADER,
+            )
+
+            stats["request_id"] = r.headers.get(REQUEST_ID_HEADER, "")
+            stats["trace_summary"] = r.headers.get(TRACE_HEADER, "")
             return r.json()
         try:
             retry_after = float(r.headers.get("Retry-After", ""))
@@ -100,6 +110,21 @@ def predict_url(
         stats["retried_shed"] += 1
         time.sleep(delay)
     raise AssertionError("unreachable")  # loop always returns or raises
+
+
+def fetch_trace(gateway_url: str, rid: str, timeout: float = 5.0) -> list[dict]:
+    """GET the merged cross-tier waterfall for a served request.
+
+    The gateway's /debug/trace/<rid> already merges the model tier's spans
+    in (it knows the replica list), so one call yields the full timeline.
+    Returns the span dicts; raises for HTTP errors (404 = trace evicted
+    from the ring buffer or id never seen).
+    """
+    import requests
+
+    r = requests.get(f"{gateway_url}/debug/trace/{rid}", timeout=timeout)
+    r.raise_for_status()
+    return r.json()["spans"]
 
 
 def predict_images(
@@ -132,6 +157,12 @@ def main(argv: list[str] | None = None) -> int:
         "--retries", type=int, default=2,
         help="bounded retries on 503 shed responses (honors Retry-After)",
     )
+    p.add_argument(
+        "--trace", action="store_true",
+        help="after the prediction, fetch /debug/trace/<rid> from the "
+        "gateway (which merges the model tier's spans in) and render the "
+        "request's cross-tier span waterfall",
+    )
     args = p.parse_args(argv)
     stats: dict = {}
     scores = predict_url(
@@ -139,6 +170,20 @@ def main(argv: list[str] | None = None) -> int:
         retries=args.retries, deadline_ms=args.deadline_ms, stats=stats,
     )
     print(json.dumps(scores, indent=2))
+    if args.trace:
+        from kubernetes_deep_learning_tpu.utils.trace import render_waterfall
+
+        rid = stats.get("request_id", "")
+        if not rid:
+            print("# no X-Request-Id on the response; cannot fetch the trace",
+                  file=sys.stderr)
+        else:
+            try:
+                spans = fetch_trace(args.gateway, rid)
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                print(f"# trace fetch failed: {e}", file=sys.stderr)
+            else:
+                print(render_waterfall(spans), file=sys.stderr)
     if stats.get("retried_shed") or stats.get("retried_connect"):
         # Distinct labels: shed retries mean overload (the tier said wait),
         # connect retries mean instability (a replica dropped the request).

@@ -50,12 +50,15 @@ from kubernetes_deep_learning_tpu.serving.admission import (
 from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 from kubernetes_deep_learning_tpu.serving.microbatch import UpstreamStall
 from kubernetes_deep_learning_tpu.serving.tracing import (
+    PARENT_SPAN_HEADER,
     REQUEST_ID_HEADER,
+    TRACE_HEADER,
     ensure_request_id,
     log_request,
 )
 from kubernetes_deep_learning_tpu.serving.upstream import UpstreamPool, parse_hosts
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 DEFAULT_PORT = 9696          # reference gateway port (gateway.dockerfile:15-16)
 DEFAULT_SERVING_HOST = "localhost:8500"  # reference model_server.py:13
@@ -140,6 +143,11 @@ class Gateway:
         self._spec_lock = threading.Lock()
 
         self.registry = metrics_lib.Registry()
+        # Per-request span traces (utils.trace): the gateway half of the
+        # cross-tier waterfall.  /debug/trace/<rid> on this tier MERGES the
+        # model tier's spans in (fetched from the replica pool), so one GET
+        # yields the full client-visible timeline.
+        self.tracer = trace_lib.Tracer("gateway")
         self._m_requests = self.registry.counter("kdlt_gateway_requests_total", "requests")
         self._m_errors = self.registry.counter("kdlt_gateway_errors_total", "errors")
         self._m_latency = self.registry.histogram(
@@ -261,6 +269,15 @@ class Gateway:
         self._m_fetch.observe(time.perf_counter() - t0)
         return image
 
+    def _fetch_one_traced(self, url: str, trace=None):
+        """_fetch_one under a ``gateway.preprocess`` span.  Kept separate so
+        _fetch_one's single-argument surface (which tests monkeypatch) stays
+        stable whether or not the request is traced."""
+        if trace is None:
+            return self._fetch_one(url)
+        with trace.span("gateway.preprocess"):
+            return self._fetch_one(url)
+
     def _validate_replica_spec(self, replica) -> None:
         """Failover spec re-validation: before a replica other than the
         reference source serves traffic, its contract must match the pool's
@@ -284,13 +301,16 @@ class Gateway:
                 f"model contract than the pool reference", 502,
             )
 
-    def _post_once(self, replica, body, request_id, deadline, timeout):
+    def _post_once(self, replica, body, request_id, deadline, timeout,
+                   span_id: str = ""):
         """One upstream POST to one replica (headers re-measured now)."""
         if self._faults is not None:
             self._faults.fire("gateway.upstream")
         headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
         if request_id:  # cross-tier trace propagation
             headers[REQUEST_ID_HEADER] = request_id
+        if span_id:  # this attempt's span: the model tier's root parent
+            headers[PARENT_SPAN_HEADER] = span_id
         if deadline is not None:  # remaining budget, re-measured now
             headers[DEADLINE_HEADER] = deadline.header_value()
         return self._session().post(
@@ -300,8 +320,41 @@ class Gateway:
             timeout=timeout,
         )
 
+    def _attempt_traced(self, replica, body, request_id, deadline, timeout,
+                        trace, role: str):
+        """One upstream POST recorded as a ``gateway.upstream`` span.
+
+        Returns ``(response, span)``; on failure records the span with the
+        error tag and re-raises.  The span id travels upstream as
+        X-Kdlt-Parent-Span, so the model tier's subtree hangs off THIS
+        attempt -- which is what makes a hedged request's waterfall show
+        two distinguishable model-tier executions.
+        """
+        if trace is None:
+            return self._post_once(replica, body, request_id, deadline, timeout), None
+        sid = trace_lib.new_span_id()
+        w0 = trace_lib.now_s()
+        try:
+            r = self._post_once(
+                replica, body, request_id, deadline, timeout, span_id=sid
+            )
+        except Exception as e:
+            trace.tracer.record(
+                trace.trace_id, "gateway.upstream", w0,
+                trace_lib.now_s() - w0, parent_id=trace.span_id, span_id=sid,
+                replica=replica.host, role=role, error=str(e)[:120],
+            )
+            raise
+        span = trace.tracer.record(
+            trace.trace_id, "gateway.upstream", w0, trace_lib.now_s() - w0,
+            parent_id=trace.span_id, span_id=sid,
+            replica=replica.host, role=role, status=r.status_code,
+        )
+        return r, span
+
     def _post_hedged(
-        self, primary, body, request_id, deadline, timeout, tried
+        self, primary, body, request_id, deadline, timeout, tried,
+        trace=None, role: str = "primary",
     ):
         """POST with a deadline-budget-aware hedged second attempt.
 
@@ -331,21 +384,27 @@ class Gateway:
             )
         )
         if not hedgeable:
-            return primary, self._post_once(
-                primary, body, request_id, deadline, timeout
+            r, span = self._attempt_traced(
+                primary, body, request_id, deadline, timeout, trace, role
             )
+            if span is not None:
+                span.tags["winner"] = True
+            return primary, r
         import queue as queue_lib
 
         results: queue_lib.Queue = queue_lib.Queue()
 
-        def attempt(rep):
+        def attempt(rep, rep_role):
             try:
-                results.put((rep, self._post_once(rep, body, request_id, deadline, timeout), None))
+                r, span = self._attempt_traced(
+                    rep, body, request_id, deadline, timeout, trace, rep_role
+                )
+                results.put((rep, r, None, span))
             except Exception as e:  # noqa: BLE001 - reported via the queue
-                results.put((rep, None, e))
+                results.put((rep, None, e, None))
 
         threading.Thread(
-            target=attempt, args=(primary,), name="kdlt-upstream-primary",
+            target=attempt, args=(primary, role), name="kdlt-upstream-primary",
             daemon=True,
         ).start()
         try:
@@ -365,8 +424,8 @@ class Gateway:
                 if pool.m_hedge_fired is not None:
                     pool.m_hedge_fired.inc()
                 threading.Thread(
-                    target=attempt, args=(hedge,), name="kdlt-upstream-hedge",
-                    daemon=True,
+                    target=attempt, args=(hedge, "hedge"),
+                    name="kdlt-upstream-hedge", daemon=True,
                 ).start()
                 first = results.get()
         outcomes = [first]
@@ -379,8 +438,13 @@ class Gateway:
             # caller's 503/failover policy applies) over raising.
             winner = next((o for o in outcomes if o[1] is not None), None)
         if winner is not None:
-            rep, r, _exc = winner
-            for lrep, lr, lexc in outcomes:
+            rep, r, _exc, span = winner
+            if span is not None:
+                # The used attempt is marked on the trace: a hedged
+                # request's waterfall shows BOTH attempt spans and which
+                # one's response the client actually got.
+                span.tags["winner"] = True
+            for lrep, lr, lexc, _lspan in outcomes:
                 if lrep is rep:
                     continue  # the caller accounts the winner's outcome
                 if lexc is not None or (lr is not None and lr.status_code >= 500):
@@ -393,7 +457,7 @@ class Gateway:
         # Every observed attempt raised: account the hedge's failure here
         # (the caller only knows the primary) and re-raise the primary's.
         primary_exc = None
-        for lrep, _lr, lexc in outcomes:
+        for lrep, _lr, lexc, _lspan in outcomes:
             if lrep is primary:
                 primary_exc = lexc
                 continue
@@ -407,7 +471,7 @@ class Gateway:
         """A hedged attempt outcome worth returning: a response that is not
         a server-side failure (2xx-4xx means the tier is up and judged the
         request on its merits)."""
-        _rep, r, exc = outcome
+        _rep, r, exc, _span = outcome
         return exc is None and r is not None and r.status_code < 500
 
     @staticmethod
@@ -427,7 +491,11 @@ class Gateway:
         )
 
     def _predict_batch(
-        self, images, request_id: str = "", deadline: Deadline | None = None
+        self,
+        images,
+        request_id: str = "",
+        deadline: Deadline | None = None,
+        trace=None,
     ) -> tuple[list, list[str]]:
         """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
 
@@ -495,7 +563,9 @@ class Gateway:
             try:
                 self._validate_replica_spec(replica)
                 replica, r = self._post_hedged(
-                    replica, body, request_id, deadline, timeout, tried
+                    replica, body, request_id, deadline, timeout, tried,
+                    trace=trace,
+                    role="failover" if tried else "primary",
                 )
             except (
                 requests.RequestException,
@@ -556,19 +626,37 @@ class Gateway:
         return logits, labels
 
     def apply_model(
-        self, url: str, request_id: str = "", deadline: Deadline | None = None
+        self,
+        url: str,
+        request_id: str = "",
+        deadline: Deadline | None = None,
+        trace=None,
     ) -> dict[str, float]:
         """url -> {label: score}; the reference's apply_model
         (reference model_server.py:52-56)."""
-        image = self._fetch_one(url)
+        image = self._fetch_one_traced(url, trace)
         if self._microbatcher is not None:
-            row, labels = self._microbatcher.predict(
-                image,
-                request_id,
-                timeout=None if deadline is None else deadline.remaining_s(),
-            )
+            # Micro-batched flushes coalesce MANY requests' upstream hop
+            # into one POST; the upstream attempt is not attributable to a
+            # single request's subtree, so the trace records the wait as
+            # one span instead.
+            if trace is None:
+                row, labels = self._microbatcher.predict(
+                    image,
+                    request_id,
+                    timeout=None if deadline is None else deadline.remaining_s(),
+                )
+            else:
+                with trace.span("gateway.microbatch"):
+                    row, labels = self._microbatcher.predict(
+                        image,
+                        request_id,
+                        timeout=None if deadline is None else deadline.remaining_s(),
+                    )
             return dict(zip(labels, map(float, row)))
-        logits, labels = self._predict_batch(image[None], request_id, deadline)
+        logits, labels = self._predict_batch(
+            image[None], request_id, deadline, trace
+        )
         return dict(zip(labels, map(float, logits[0])))
 
     def apply_model_batch(
@@ -576,6 +664,7 @@ class Gateway:
         urls: list[str],
         request_id: str = "",
         deadline: Deadline | None = None,
+        trace=None,
     ) -> list[dict]:
         """urls -> per-url {label: score} or {"error": ...}, order-preserving.
 
@@ -596,7 +685,9 @@ class Gateway:
             )
         self.spec  # discover upstream contract FIRST: outage => 502, not 200
         with ThreadPoolExecutor(max_workers=min(len(urls), MAX_BATCH_FETCHERS)) as ex:
-            fetched = list(ex.map(self._fetch_one_safe, urls))
+            fetched = list(
+                ex.map(lambda u: self._fetch_one_safe(u, trace), urls)
+            )
         good = [(i, img) for i, (img, _) in enumerate(fetched) if img is not None]
         results: list[dict] = [
             {"error": err} if err is not None else {} for _, err in fetched
@@ -605,15 +696,15 @@ class Gateway:
             import numpy as np
 
             logits, labels = self._predict_batch(
-                np.stack([img for _, img in good]), request_id, deadline
+                np.stack([img for _, img in good]), request_id, deadline, trace
             )
             for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
         return results
 
-    def _fetch_one_safe(self, url: str):
+    def _fetch_one_safe(self, url: str, trace=None):
         try:
-            return self._fetch_one(url), None
+            return self._fetch_one_traced(url, trace), None
         except UpstreamError:
             raise  # model-tier trouble is the request's failure, not the URL's
         except Exception as e:
@@ -640,7 +731,38 @@ class Gateway:
                 return 503, str(e).encode(), "text/plain"
         if path == "/metrics":
             return 200, self.registry.render().encode(), "text/plain"
+        if path.startswith("/debug/trace/"):
+            return self.handle_trace(path.rsplit("/", 1)[-1])
         return 404, b'{"error": "not found"}', "application/json"
+
+    def handle_trace(self, raw_rid: str) -> tuple[int, bytes, str]:
+        """GET /debug/trace/<rid>: the MERGED cross-tier waterfall.
+
+        This tier's spans plus every model-tier replica's spans for the
+        same trace id (fetched from their /debug/trace endpoints -- the
+        gateway is the only tier that knows the replica list), sorted on
+        the shared timeline.  An unreachable replica degrades to a partial
+        trace, never an error: the debug surface must work best exactly
+        when the serving path is misbehaving.
+        """
+        rid = ensure_request_id(raw_rid)
+        spans = self.tracer.spans(rid) or []
+        for replica in self.pool.replicas:
+            try:
+                r = self._session().get(
+                    f"{replica.base}/debug/trace/{rid}", timeout=2.0
+                )
+                if r.status_code == 200:
+                    spans.extend(r.json().get("spans", []))
+            except Exception:  # noqa: BLE001 - partial traces beat no traces
+                continue
+        if not spans:
+            return 404, json.dumps(
+                {"error": f"no trace for {rid!r} on any tier"}
+            ).encode(), "application/json"
+        return 200, json.dumps(
+            {"trace_id": rid, "spans": trace_lib.sort_spans(spans)}
+        ).encode(), "application/json"
 
     def reject_oversize(self, length: int) -> tuple[int, bytes, str] | None:
         """Pre-read Content-Length check shared by both transports; returns
@@ -678,6 +800,11 @@ class Gateway:
         """
         t0 = time.perf_counter()
         rid = request_id or ensure_request_id(None)
+        # This request's trace (trace id = rid): the root span carrier every
+        # child span -- admission, preprocess, upstream attempts -- nests
+        # under, and the key /debug/trace/<rid> serves the waterfall by.
+        rt = self.tracer.request_trace(rid)
+        w_start = trace_lib.now_s()
         self._m_requests.inc()
         status = 500
         n_urls = 1
@@ -686,7 +813,8 @@ class Gateway:
             if deadline is None and self.admission.enabled:
                 deadline = Deadline.default()
             try:
-                ticket = self.admission.admit(deadline)
+                with rt.span("gateway.admission"):
+                    ticket = self.admission.admit(deadline)
             except Shed as e:
                 self._m_errors.inc()
                 status = e.http_status
@@ -698,10 +826,10 @@ class Gateway:
                 # reference's schema (reference test.py:15) and unchanged
                 urls = list(req["urls"])
                 n_urls = len(urls)
-                preds = self.apply_model_batch(urls, rid, deadline)
+                preds = self.apply_model_batch(urls, rid, deadline, trace=rt)
                 status = 200
                 return 200, json.dumps({"predictions": preds}).encode(), "application/json", {}
-            scores = self.apply_model(req["url"], rid, deadline)
+            scores = self.apply_model(req["url"], rid, deadline, trace=rt)
             status = 200
             return 200, json.dumps(scores).encode(), "application/json", {}
         except UpstreamError as e:
@@ -737,10 +865,20 @@ class Gateway:
             if ticket is not None:
                 ticket.release()
             self._m_latency.observe(time.perf_counter() - t0)
+            # Root span last (it covers the whole handler); the transports
+            # build the X-Kdlt-Trace header AFTER handle_predict returns,
+            # so the header summary includes it.
+            self.tracer.record(
+                rid, "gateway.request", w_start, trace_lib.now_s() - w_start,
+                span_id=rt.span_id, status=status, urls=n_urls,
+            )
             # Sheds (503/504) skip the always-log rule: rejection must stay
             # cheap under overload; kdlt_admission_shed_total counts them.
             if self.request_log or (status >= 500 and status not in (503, 504)):
-                log_request("gateway predict", rid, status=status, t0=t0, urls=n_urls)
+                log_request(
+                    "gateway predict", rid, status=status, t0=t0,
+                    span_id=rt.span_id, urls=n_urls,
+                )
 
     # --- HTTP plumbing ----------------------------------------------------
 
@@ -749,6 +887,13 @@ class Gateway:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: http.server writes a response as two send()s
+            # (header buffer, then body); with Nagle on, the body segment
+            # waits out the peer's delayed ACK of the header segment -- a
+            # flat ~40 ms added to every response on Linux.  Found by the
+            # span tracer: client wall minus the gateway.request root span
+            # was a constant ~40 ms that belonged to no stage.
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):
                 pass
@@ -791,6 +936,11 @@ class Gateway:
                 status, out, ctype, extra = gw.handle_predict(
                     self.rfile.read(length), rid, deadline
                 )
+                # Server-Timing-style span summary; handle_predict has
+                # recorded the full trace (root included) by return time.
+                summary = gw.tracer.summary(rid)
+                if summary:
+                    extra = {**extra, TRACE_HEADER: summary}
                 self._send(status, out, ctype, rid, extra)
 
         return Handler

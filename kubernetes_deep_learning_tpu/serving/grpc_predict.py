@@ -200,14 +200,25 @@ class PredictionServicer:
         PredictionService methods."""
         from kubernetes_deep_learning_tpu.serving.tracing import (
             GRPC_METADATA_KEY,
+            GRPC_PARENT_SPAN_KEY,
             ensure_request_id,
+            ensure_span_id,
             log_request,
         )
+        from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
         t0 = time.perf_counter()
-        raw = dict(context.invocation_metadata()).get(GRPC_METADATA_KEY)
-        rid = ensure_request_id(raw)
+        metadata = dict(context.invocation_metadata())
+        rid = ensure_request_id(metadata.get(GRPC_METADATA_KEY))
         context.set_trailing_metadata(((GRPC_METADATA_KEY, rid),))
+        # Same trace surface as the HTTP transport: the rid is the trace
+        # id, the caller's span id arrives in x-kdlt-parent-span metadata,
+        # and the RPC's root span lands in the shared model-server tracer
+        # (so /debug/trace/<rid> covers gRPC requests too).
+        parent = ensure_span_id(metadata.get(GRPC_PARENT_SPAN_KEY))
+        tracer = getattr(self._server, "tracer", None)
+        rt = tracer.request_trace(rid, parent) if tracer is not None else None
+        w_start = trace_lib.now_s()
         status = "INTERNAL"
         self._m_requests.inc()
         try:
@@ -259,12 +270,19 @@ class PredictionServicer:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
         finally:
             self._m_latency.observe(time.perf_counter() - t0)
+            if rt is not None:
+                tracer.record(
+                    rid, f"grpc.{kind}", w_start,
+                    trace_lib.now_s() - w_start,
+                    parent_id=parent, span_id=rt.span_id, status=status,
+                )
             if self._server.request_log or status == "INTERNAL":
                 log_request(
                     f"model-server grpc-{kind}",
                     rid,
                     status=status,
                     t0=t0,
+                    span_id=rt.span_id if rt is not None else None,
                     model=_request_model_name(request),
                 )
 

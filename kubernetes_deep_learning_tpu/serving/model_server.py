@@ -68,17 +68,22 @@ from kubernetes_deep_learning_tpu.serving.admission import (
 )
 from kubernetes_deep_learning_tpu.serving.admission import limiter as limiter_mod
 from kubernetes_deep_learning_tpu.serving.tracing import (
+    PARENT_SPAN_HEADER,
     REQUEST_ID_HEADER,
+    TRACE_HEADER,
     ensure_request_id,
+    ensure_span_id,
     log_request,
 )
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
 _MODEL_RE = re.compile(r"^/v1/models/([^/:]+)$")
 
 DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-model-service.yaml:9-10)
 MAX_IMAGES_PER_REQUEST = 2048  # bounds one request's decoded-image memory
+PROFILE_DIR_ENV = "KDLT_PROFILE_DIR"  # base dir for /debug/profile captures
 
 
 class ServedModel:
@@ -149,8 +154,14 @@ class ServedModel:
             raise
 
     def predict(
-        self, images: np.ndarray, deadline: Deadline | None = None
+        self,
+        images: np.ndarray,
+        deadline: Deadline | None = None,
+        trace=None,
     ) -> np.ndarray:
+        # ``trace`` (utils.trace.RequestTrace): the handler's server.predict
+        # span carrier; the batcher/dispatcher record this request's
+        # queue-wait and pipeline-stage spans under it.
         # Deadline-aware waits (serving.admission): every blocking wait
         # below -- the batcher future, the chunked dispatcher futures -- is
         # bounded by the request's REMAINING budget instead of a fixed
@@ -173,7 +184,9 @@ class ServedModel:
             and images.dtype == np.uint8
         ):
             try:
-                return self.batcher.predict(images[0], timeout=batcher_timeout)[None]
+                return self.batcher.predict(
+                    images[0], timeout=batcher_timeout, trace=trace
+                )[None]
             except BatcherClosed:
                 # A hot reload closed this version's batcher while the
                 # handler already held a reference to it; the engine is
@@ -182,6 +195,9 @@ class ServedModel:
                 pass
         max_b = self.engine.max_batch
         if images.shape[0] <= max_b:
+            if trace is not None:
+                with trace.span("engine.predict", batch=int(images.shape[0])):
+                    return self.engine.predict(images)
             return self.engine.predict(images)
         # Batches beyond the bucket ladder are served in max-bucket chunks
         # rather than erroring: the client's batch size should not have to
@@ -192,7 +208,10 @@ class ServedModel:
         if self.dispatcher is not None and images.dtype == np.uint8:
             try:
                 futs = [
-                    self.dispatcher.submit(images[i : i + max_b])
+                    self.dispatcher.submit(
+                        images[i : i + max_b],
+                        traces=(trace,) if trace is not None else (),
+                    )
                     for i in range(0, images.shape[0], max_b)
                 ]
                 return np.concatenate(
@@ -247,14 +266,21 @@ class ModelServer:
         )
 
         enable_compile_cache()
-        # profile_base: directory for /debug/profile traces; "" means a
-        # default under the system temp dir, None disables the endpoint.
+        # profile_base: directory for /debug/profile traces; "" means
+        # $KDLT_PROFILE_DIR (or a default under the system temp dir), None
+        # disables the endpoint.
+        if profile_base == "":
+            profile_base = os.environ.get(PROFILE_DIR_ENV, "").strip()
         if profile_base == "":
             import tempfile as _tf
 
             profile_base = os.path.join(_tf.gettempdir(), "kdlt-traces")
         self._profile_base = profile_base
         self.registry = metrics_lib.Registry()
+        # Per-request span traces (utils.trace): the model-tier half of the
+        # cross-tier waterfall, keyed by the propagated X-Request-Id and
+        # served at /debug/trace/<rid>.
+        self.tracer = trace_lib.Tracer("model-server")
         # Fault injection (serving.faults): the server.predict point; None
         # (zero-overhead) unless $KDLT_FAULTS configures rules.
         self._faults = faults_lib.from_env()
@@ -441,6 +467,10 @@ class ModelServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # TCP_NODELAY: same two-send() response stall as the gateway
+            # handler (see its comment) -- without it a pooled upstream
+            # connection can eat a ~40 ms delayed-ACK pause per response.
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # quiet; metrics cover it
                 pass
@@ -459,6 +489,14 @@ class ModelServer:
                     self.send_header("Connection", "close")
                 if getattr(self, "_rid", ""):
                     self.send_header(REQUEST_ID_HEADER, self._rid)
+                    # Server-Timing-style span summary for THIS tier: the
+                    # spans recorded so far (admission, decode, batcher
+                    # queue, pipeline stages -- all finish before the
+                    # response is sent; only the root request span, which
+                    # by definition closes after the send, is absent).
+                    summary = server.tracer.summary(self._rid)
+                    if summary:
+                        self.send_header(TRACE_HEADER, summary)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -531,6 +569,22 @@ class ModelServer:
                     return self._send(503, b"warming up", "text/plain")
                 if self.path == "/metrics":
                     return self._send(200, server.registry.render().encode(), "text/plain")
+                if self.path.startswith("/debug/trace/"):
+                    rid = ensure_request_id(self.path.rsplit("/", 1)[-1])
+                    spans = server.tracer.spans(rid)
+                    if spans is None:
+                        return self._send_json(
+                            404, {"error": f"no trace for {rid!r} (evicted "
+                                  "from the ring buffer or never seen)"}
+                        )
+                    return self._send_json(
+                        200,
+                        {"trace_id": rid, "tier": "model-server", "spans": spans},
+                    )
+                if self.path.split("?", 1)[0] == "/debug/profile":
+                    # GET /debug/profile?seconds=N: the curl-friendly form
+                    # of the POST endpoint below (same capture, same lock).
+                    return self._profile()
                 if self.path == "/v1/models":
                     return self._send_json(
                         200,
@@ -558,9 +612,15 @@ class ModelServer:
                 t0 = time.perf_counter()
                 # The traced id from the gateway (or minted here for direct
                 # clients): echoed in the response and stamped on this tier's
-                # log line, completing the cross-tier trace.
+                # log line, completing the cross-tier trace.  The gateway's
+                # upstream-attempt span id arrives in X-Kdlt-Parent-Span, so
+                # this tier's root span nests under the exact attempt
+                # (primary, failover, or hedge) that carried the request.
                 rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
                 self._rid = rid
+                parent = ensure_span_id(self.headers.get(PARENT_SPAN_HEADER))
+                rt = server.tracer.request_trace(rid, parent)
+                w_start = trace_lib.now_s()
                 status = 500
                 batch = 0
                 self._body_consumed = False
@@ -588,7 +648,8 @@ class ModelServer:
                     # Admission BEFORE the body is read or decoded: an
                     # exhausted or shed request must cost no decode work and
                     # never touch the TPU.
-                    ticket = server.admission.admit(deadline)
+                    with rt.span("server.admission"):
+                        ticket = server.admission.admit(deadline)
                     if server._faults is not None:
                         # server.predict fault point: error/latency/hang/
                         # disconnect strike the handler here (admitted, body
@@ -617,10 +678,11 @@ class ModelServer:
                             f"{limit}-byte limit "
                             f"({MAX_IMAGES_PER_REQUEST}-image cap)"
                         )
-                    body = self.rfile.read(length)
-                    self._body_consumed = True
-                    ctype = self.headers.get("Content-Type", "")
-                    images = protocol.decode_predict_request(body, ctype)
+                    with rt.span("server.decode", bytes=length):
+                        body = self.rfile.read(length)
+                        self._body_consumed = True
+                        ctype = self.headers.get("Content-Type", "")
+                        images = protocol.decode_predict_request(body, ctype)
                     if images.ndim == 3:
                         images = images[None]
                     if images.shape[1:] != spec.input_shape:
@@ -633,7 +695,10 @@ class ModelServer:
                             f"{MAX_IMAGES_PER_REQUEST}-image request limit"
                         )
                     batch = images.shape[0]
-                    logits = model.predict(images, deadline=deadline)
+                    with rt.span("server.predict", batch=batch) as pt:
+                        logits = model.predict(
+                            images, deadline=deadline, trace=pt
+                        )
                     out, out_ctype = protocol.encode_predict_response(
                         logits, spec.labels, ctype
                     )
@@ -702,6 +767,15 @@ class ModelServer:
                     if ticket is not None:
                         ticket.release()
                     server._m_latency.observe(time.perf_counter() - t0)
+                    # Root span last: it closes after the response went out,
+                    # which is why the X-Kdlt-Trace header carries only the
+                    # sub-spans while /debug/trace/<rid> has everything.
+                    server.tracer.record(
+                        rid, "server.request", w_start,
+                        trace_lib.now_s() - w_start,
+                        parent_id=parent, span_id=rt.span_id,
+                        status=status, batch=batch,
+                    )
                     # Sheds (503/504) are excluded from the always-log rule:
                     # rejection must stay cheap under overload (a log line
                     # per shed IS load), and kdlt_admission_shed_total
@@ -714,6 +788,7 @@ class ModelServer:
                             rid,
                             status=status,
                             t0=t0,
+                            span_id=rt.span_id,
                             model=m.group(1),
                             batch=batch,
                         )
@@ -730,11 +805,18 @@ class ModelServer:
                 if server._profile_base is None:
                     return self._send_json(404, {"error": "profiling disabled"})
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length)) if length else {}
-                    if not isinstance(req, dict):
-                        raise ValueError("body must be a JSON object")
-                    seconds = float(req.get("seconds", 2.0))
+                    if self.command == "GET":
+                        # GET /debug/profile?seconds=N (curl-friendly).
+                        from urllib.parse import parse_qs, urlparse
+
+                        q = parse_qs(urlparse(self.path).query)
+                        seconds = float(q.get("seconds", ["2.0"])[0])
+                    else:
+                        length = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(length)) if length else {}
+                        if not isinstance(req, dict):
+                            raise ValueError("body must be a JSON object")
+                        seconds = float(req.get("seconds", 2.0))
                     if not 0 < seconds <= 60:
                         raise ValueError("seconds must be in (0, 60]")
                     # Client input never chooses the path: traces go into a

@@ -44,6 +44,7 @@ class GatewayWSGI:
         )
         from kubernetes_deep_learning_tpu.serving.tracing import (
             REQUEST_ID_HEADER,
+            TRACE_HEADER,
             ensure_request_id,
         )
 
@@ -68,6 +69,10 @@ class GatewayWSGI:
                 code, body, ctype, extra = self.gateway.handle_predict(
                     environ["wsgi.input"].read(length), rid, deadline
                 )
+                # Same span-summary header as the threaded transport.
+                summary = self.gateway.tracer.summary(rid)
+                if summary:
+                    extra = {**extra, TRACE_HEADER: summary}
         else:
             code, body, ctype = 404, b'{"error": "not found"}', "application/json"
         start_response(

@@ -163,14 +163,30 @@ def dispatch_stall_counter(registry: "Registry") -> "Counter":
     )
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline.
+    Without it a label value containing '"' or '\\n' desyncs strict
+    parsers for the whole exposition."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping per the exposition format: backslash + newline."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict[str, str] | None, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in (labels or {}).items()]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in (labels or {}).items()]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
 class Counter:
+    kind = "counter"
+
     def __init__(self, name: str, help: str = "", labels: dict[str, str] | None = None):
         self.name, self.help, self.labels = name, help, labels
         self._value = 0.0
@@ -184,25 +200,23 @@ class Counter:
     def value(self) -> float:
         return self._value
 
+    def sample_lines(self) -> list[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {self._value}"]
+
     def render(self) -> str:
         return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} counter\n"
-            f"{self.name}{_fmt_labels(self.labels)} {self._value}\n"
+            f"# HELP {self.name} {_escape_help(self.help)}\n"
+            f"# TYPE {self.name} {self.kind}\n"
+            + "\n".join(self.sample_lines()) + "\n"
         )
 
 
 class Gauge(Counter):
+    kind = "gauge"
+
     def set(self, v: float) -> None:
         with self._lock:
             self._value = v
-
-    def render(self) -> str:
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} gauge\n"
-            f"{self.name}{_fmt_labels(self.labels)} {self._value}\n"
-        )
 
 
 class Histogram:
@@ -249,11 +263,10 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
-    def render(self) -> str:
-        out = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} histogram",
-        ]
+    kind = "histogram"
+
+    def sample_lines(self) -> list[str]:
+        out = []
         cum = 0
         with self._lock:
             for le, c in zip(self.buckets, self._counts):
@@ -265,7 +278,14 @@ class Histogram:
             out.append(f"{self.name}_bucket{_fmt_labels(self.labels, inf_label)} {cum}")
             out.append(f"{self.name}_sum{_fmt_labels(self.labels)} {self._sum}")
             out.append(f"{self.name}_count{_fmt_labels(self.labels)} {self._n}")
-        return "\n".join(out) + "\n"
+        return out
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {_escape_help(self.help)}\n"
+            f"# TYPE {self.name} {self.kind}\n"
+            + "\n".join(self.sample_lines()) + "\n"
+        )
 
 
 class Registry:
@@ -316,6 +336,43 @@ class Registry:
                         (name, tuple(sorted((m.labels or {}).items())))
                     )
 
-    def render(self) -> str:
+    def _leaves(self):
+        """Every leaf metric under this registry, depth-first, in creation
+        order (child registries flattened in place)."""
         with self._lock:
-            return "".join(m.render() for m in self._metrics)
+            metrics = list(self._metrics)
+        for m in metrics:
+            if isinstance(m, Registry):
+                yield from m._leaves()
+            else:
+                yield m
+
+    def render(self) -> str:
+        """Prometheus text exposition, grouped by metric name.
+
+        Labeled series sharing a name (e.g. the per-reason
+        kdlt_admission_shed_total counters) must render under ONE
+        ``# HELP``/``# TYPE`` block: the format forbids repeating the
+        metadata lines, and strict parsers (promtool, the Prometheus
+        scraper in some configurations) reject the duplicate blocks the
+        naive per-metric concatenation used to produce.  First-seen
+        ordering keeps the page stable across renders; the first series'
+        HELP/TYPE wins for its name.
+        """
+        order: list[str] = []
+        meta: dict[str, tuple[str, str]] = {}
+        samples: dict[str, list[str]] = {}
+        for m in self._leaves():
+            name = m.name
+            if name not in meta:
+                order.append(name)
+                meta[name] = (m.kind, m.help)
+                samples[name] = []
+            samples[name].extend(m.sample_lines())
+        out: list[str] = []
+        for name in order:
+            kind, help = meta[name]
+            out.append(f"# HELP {name} {_escape_help(help)}")
+            out.append(f"# TYPE {name} {kind}")
+            out.extend(samples[name])
+        return "\n".join(out) + "\n" if out else ""

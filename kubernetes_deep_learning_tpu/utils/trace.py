@@ -1,0 +1,269 @@
+"""Dapper-style per-request span tracing for the serving path.
+
+Aggregate histograms (utils.metrics) answer "how slow is the fleet"; they
+cannot answer "where did THIS request's 480 ms go" -- the question tail
+debugging actually asks (Sigelman et al. 2010; Dean & Barroso, "The Tail
+at Scale", 2013).  This module is the in-process tracing core both serving
+tiers share:
+
+- a **trace id** rides the existing ``X-Request-Id`` propagation path (the
+  sanitized request id IS the trace id -- one grep key for logs, headers,
+  and traces);
+- each tier records **spans** (name, start, duration, parent span id,
+  tags) into a bounded in-process ring buffer (:class:`Tracer`), exposed
+  at ``/debug/trace/<rid>``;
+- the **parent span id** crosses tier boundaries in the
+  ``X-Kdlt-Parent-Span`` header (gRPC: ``x-kdlt-parent-span`` metadata),
+  so the model tier's spans nest under the exact gateway upstream attempt
+  that carried them -- a hedged request shows BOTH attempts, each with its
+  own subtree;
+- every response carries a ``Server-Timing``-style ``X-Kdlt-Trace``
+  summary header, so a curl sees the per-tier breakdown without a second
+  round trip.
+
+Timestamps come from one wall-anchored monotonic clock per process
+(``now_s``): spans recorded by different threads of one process can never
+be reordered by wall-clock steps, so child intervals derived from shared
+perf-counter boundaries (the dispatcher's pipeline stages) are exactly
+non-overlapping in the waterfall.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from contextlib import contextmanager
+
+# Response header: Server-Timing-style per-tier span summary.
+TRACE_HEADER = "X-Kdlt-Trace"
+# Request header: the caller's active span id, which becomes the parent of
+# this tier's root span.  Rides next to X-Request-Id (the trace id).
+PARENT_SPAN_HEADER = "X-Kdlt-Parent-Span"
+GRPC_PARENT_SPAN_KEY = "x-kdlt-parent-span"  # gRPC metadata keys are lowercase
+
+_SPAN_ID_RE = re.compile(r"[^A-Za-z0-9]")
+
+# One wall-anchored monotonic clock per process: perf_counter deltas on a
+# wall-time anchor.  time.time() alone can step (NTP) mid-request, which
+# would fabricate overlapping/negative child intervals.
+_WALL0 = time.time()
+_PERF0 = time.perf_counter()
+
+
+def now_s() -> float:
+    """Current wall time on the process's monotonic-anchored clock."""
+    return _WALL0 + (time.perf_counter() - _PERF0)
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+def ensure_span_id(raw: str | None) -> str | None:
+    """Sanitized inbound parent span id, or None (same hostile-header
+    posture as tracing.ensure_request_id: a client-chosen value must not
+    inject header or log structure)."""
+    if not raw:
+        return None
+    sid = _SPAN_ID_RE.sub("", raw)[:32]
+    return sid or None
+
+
+class Span:
+    """One recorded interval; mutable tags so e.g. a hedge winner can be
+    marked after its attempt span was already recorded."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "tier",
+                 "start_s", "dur_s", "tags")
+
+    def __init__(self, trace_id, span_id, parent_id, name, tier,
+                 start_s, dur_s, tags=None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.tier = tier
+        self.start_s = start_s
+        self.dur_s = dur_s
+        self.tags = dict(tags or {})
+
+    @property
+    def end_s(self) -> float:
+        return self.start_s + self.dur_s
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "tier": self.tier,
+            "start_s": round(self.start_s, 6),
+            "dur_ms": round(self.dur_s * 1e3, 3),
+            "tags": {k: v for k, v in self.tags.items()},
+        }
+
+
+class Tracer:
+    """Bounded per-tier span buffer: an OrderedDict ring of recent traces.
+
+    Eviction is by TRACE (oldest first-seen trace goes when ``max_traces``
+    is exceeded), and each trace's span list is capped at ``max_spans``
+    (a runaway batch-fan-in cannot balloon one entry).  All methods are
+    thread-safe; record() is O(1) amortized -- cheap enough for the hot
+    path unconditionally, so tracing needs no sampling knob at this scale.
+    """
+
+    def __init__(self, tier: str, max_traces: int = 512, max_spans: int = 128):
+        self.tier = tier
+        self.max_traces = max_traces
+        self.max_spans = max_spans
+        self._traces: OrderedDict[str, list[Span]] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def record(
+        self,
+        trace_id: str,
+        name: str,
+        start_s: float,
+        dur_s: float,
+        parent_id: str | None = None,
+        span_id: str | None = None,
+        **tags,
+    ) -> Span:
+        span = Span(
+            trace_id, span_id or new_span_id(), parent_id, name, self.tier,
+            start_s, max(0.0, dur_s), tags,
+        )
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                while len(self._traces) >= self.max_traces:
+                    self._traces.popitem(last=False)
+                spans = self._traces[trace_id] = []
+            if len(spans) < self.max_spans:
+                spans.append(span)
+        return span
+
+    def request_trace(self, trace_id: str, parent_id: str | None = None) -> "RequestTrace":
+        """A RequestTrace rooted at a freshly minted span id; the caller
+        records the root span itself (typically in its finally block) with
+        ``span_id=rt.span_id, parent_id=rt.parent_id``."""
+        return RequestTrace(self, trace_id, new_span_id(), parent_id)
+
+    def spans(self, trace_id: str) -> list[dict] | None:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if spans is None:
+                return None
+            return [s.to_dict() for s in spans]
+
+    def summary(self, trace_id: str) -> str:
+        """Server-Timing-style summary: ``name;dur=12.3, ...`` (ms), in
+        record order.  Empty string when the trace is unknown."""
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            if not spans:
+                return ""
+            return ", ".join(
+                f"{s.name};dur={s.dur_s * 1e3:.1f}" for s in spans
+            )
+
+
+class RequestTrace:
+    """The per-request carrier plumbed down a tier's predict path.
+
+    ``span_id`` is the currently-active span -- the parent every child
+    recorded through this carrier nests under.  ``None`` is the universal
+    no-trace value: every instrumented callee takes ``trace=None`` and
+    stays zero-cost when tracing is not engaged for the request.
+    """
+
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "tags")
+
+    def __init__(self, tracer: Tracer, trace_id: str, span_id: str,
+                 parent_id: str | None = None):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tags: dict = {}
+
+    def record(self, name: str, start_s: float, dur_s: float, **tags) -> Span:
+        """Record a completed child interval under the active span."""
+        return self.tracer.record(
+            self.trace_id, name, start_s, dur_s, parent_id=self.span_id, **tags
+        )
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        """Time a block as a child span; yields the child's RequestTrace so
+        nested work (and cross-tier propagation) parents correctly.  The
+        span records even when the block raises -- a shed or failed stage
+        still belongs on the waterfall.  Extra tags set on the yielded
+        carrier's ``tags`` dict are merged at record time."""
+        child = RequestTrace(self.tracer, self.trace_id, new_span_id(), self.span_id)
+        t0 = now_s()
+        try:
+            yield child
+        finally:
+            self.tracer.record(
+                self.trace_id, name, t0, now_s() - t0,
+                parent_id=self.span_id, span_id=child.span_id,
+                **{**tags, **child.tags},
+            )
+
+
+# --- waterfall rendering (client.py --trace, bench --trace-breakdown) ------
+
+
+def sort_spans(spans: list[dict]) -> list[dict]:
+    return sorted(spans, key=lambda s: (s.get("start_s", 0.0), -s.get("dur_ms", 0.0)))
+
+
+def span_children(spans: list[dict]) -> dict:
+    """parent span_id -> children (start-ordered); key None = roots
+    (spans whose parent is absent from the set count as roots too)."""
+    ids = {s["span_id"] for s in spans}
+    out: dict = {}
+    for s in sort_spans(spans):
+        parent = s.get("parent_id")
+        key = parent if parent in ids else None
+        out.setdefault(key, []).append(s)
+    return out
+
+
+def render_waterfall(spans: list[dict], width: int = 40) -> str:
+    """ASCII waterfall of a merged trace: indent = parent depth, bar =
+    position/extent on the trace's global timeline."""
+    if not spans:
+        return "(no spans)"
+    t0 = min(s["start_s"] for s in spans)
+    t1 = max(s["start_s"] + s["dur_ms"] / 1e3 for s in spans)
+    total = max(t1 - t0, 1e-9)
+    children = span_children(spans)
+    lines = [
+        f"trace {spans[0]['trace_id']}: {len(spans)} spans, "
+        f"{total * 1e3:.1f} ms total"
+    ]
+
+    def emit(span: dict, depth: int) -> None:
+        off = int((span["start_s"] - t0) / total * width)
+        n = max(1, int(span["dur_ms"] / 1e3 / total * width))
+        bar = " " * off + "#" * min(n, width - off)
+        label = "  " * depth + f"[{span['tier']}] {span['name']}"
+        tags = "".join(
+            f" {k}={v}" for k, v in sorted(span.get("tags", {}).items())
+        )
+        lines.append(
+            f"{label:<44s} |{bar:<{width}s}| {span['dur_ms']:9.2f} ms{tags}"
+        )
+        for c in children.get(span["span_id"], ()):
+            emit(c, depth + 1)
+
+    for root in children.get(None, ()):
+        emit(root, 0)
+    return "\n".join(lines)

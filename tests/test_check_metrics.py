@@ -1,0 +1,70 @@
+"""tools/check_metrics.py wired into tier-1: the production tree must
+stay clean (every metric kdlt_-prefixed, minted via the central helpers),
+and the lint itself must actually catch the violations it claims to."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+import check_metrics  # noqa: E402
+
+
+def test_production_tree_is_clean(capsys):
+    assert check_metrics.main() == 0, capsys.readouterr().out
+
+
+def test_lint_flags_unprefixed_mint():
+    src = 'reg.counter("my_requests_total", "oops")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "not kdlt_-prefixed" in v and "my_requests_total" in v
+
+
+def test_lint_flags_non_literal_name():
+    src = 'reg.histogram(name_var, "dynamic")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "non-literal" in v
+
+
+def test_lint_accepts_kdlt_fstring_head():
+    src = 'reg.histogram(f"kdlt_pipeline_{stage}_seconds", "ok")\n'
+    assert check_metrics.lint_source(src, "fake.py") == []
+
+
+def test_lint_flags_direct_construction():
+    src = (
+        "from kubernetes_deep_learning_tpu.utils.metrics import Histogram\n"
+        'h = Histogram("kdlt_rogue_seconds")\n'
+    )
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "direct Histogram" in v
+
+    src = (
+        "from kubernetes_deep_learning_tpu.utils import metrics as m\n"
+        'c = m.Counter("kdlt_rogue_total")\n'
+    )
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "direct Counter" in v
+
+
+def test_lint_ignores_unrelated_counter_classes():
+    # collections.Counter etc. must not false-positive: only names imported
+    # from utils.metrics are metric classes.
+    src = (
+        "from collections import Counter\n"
+        "c = Counter(['a', 'b'])\n"
+    )
+    assert check_metrics.lint_source(src, "fake.py") == []
+
+
+def test_lint_exempts_central_module_construction():
+    src = 'x = Counter("anything")\n'
+    # Inside utils/metrics.py the classes ARE the implementation.
+    path = os.path.join("kubernetes_deep_learning_tpu", "utils", "metrics.py")
+    assert all(
+        "direct" not in v for v in check_metrics.lint_source(src, path)
+    )

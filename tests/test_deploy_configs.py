@@ -238,6 +238,78 @@ def test_compose_has_second_model_replica_wired_for_failover():
     assert float(gw_env[HEDGE_DELAY_ENV]) > 0
 
 
+def test_prometheus_scrape_annotations():
+    """Observability wiring (ISSUE 4 satellite): both tiers' pod templates
+    carry the prometheus.io scrape annotations, pointed at /metrics on the
+    port the container actually serves; the compose topology carries the
+    equivalent labels so a docker_sd-configured Prometheus discovers the
+    local stack the same way."""
+    from kubernetes_deep_learning_tpu.serving.gateway import (
+        DEFAULT_PORT as GATEWAY_PORT,
+    )
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        DEFAULT_PORT as MODEL_PORT,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    for fname, port in (
+        ("gateway-deployment.yaml", GATEWAY_PORT),
+        ("model-server-deployment.yaml", MODEL_PORT),
+    ):
+        (dep,) = _yaml_docs(os.path.join(k8s, fname))
+        tmpl = dep["spec"]["template"]["metadata"]
+        ann = tmpl.get("annotations", {})
+        assert ann.get("prometheus.io/scrape") == "true", fname
+        assert ann.get("prometheus.io/path") == "/metrics", fname
+        assert ann.get("prometheus.io/port") == str(port), fname
+        # The advertised scrape port must be one the container exposes.
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        assert any(
+            p["containerPort"] == port for p in container["ports"]
+        ), fname
+
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    for name, svc in compose["services"].items():
+        labels = svc.get("labels", {})
+        assert labels.get("prometheus.io/scrape") == "true", (
+            f"compose service {name!r} missing scrape labels"
+        )
+        assert labels.get("prometheus.io/path") == "/metrics", name
+
+
+def test_deploy_wires_structured_logs_and_profile_dir():
+    """The tracing/observability env wiring: JSON request logs on both
+    tiers (k8s + compose), and the model tier's KDLT_PROFILE_DIR pointed
+    at a mounted volume so /debug/profile captures survive and can be
+    copied out."""
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        PROFILE_DIR_ENV,
+    )
+    from kubernetes_deep_learning_tpu.serving.tracing import LOG_FORMAT_ENV
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    for fname in ("gateway-deployment.yaml", "model-server-deployment.yaml"):
+        (dep,) = _yaml_docs(os.path.join(k8s, fname))
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value", "") for e in container.get("env", [])}
+        assert env.get(LOG_FORMAT_ENV) == "json", fname
+
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    container = model_dep["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value", "") for e in container.get("env", [])}
+    profile_dir = env[PROFILE_DIR_ENV]
+    mounts = [m["mountPath"] for m in container.get("volumeMounts", [])]
+    assert any(profile_dir.startswith(m) for m in mounts), (
+        f"{PROFILE_DIR_ENV}={profile_dir} must live under a mounted volume"
+    )
+
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    for name, svc in compose["services"].items():
+        assert str(svc.get("environment", {}).get(LOG_FORMAT_ENV)) == "json", (
+            f"compose service {name!r} missing {LOG_FORMAT_ENV}=json"
+        )
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

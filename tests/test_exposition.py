@@ -1,0 +1,291 @@
+"""Prometheus text-exposition validity for both tiers' /metrics output.
+
+A minimal strict parser of the exposition format, covering the failure
+modes a lenient substring test never catches: duplicate HELP/TYPE blocks
+for labeled series sharing a name (the bug Registry.render used to have),
+un-escaped label values, ungrouped samples, and non-monotonic histogram
+buckets.  Runs against the FULL /metrics page of a live gateway and model
+server, so every helper in utils/metrics.py is exercised as rendered.
+"""
+
+from __future__ import annotations
+
+import re
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+import requests
+
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$")
+# One sample line: name{labels} value.  Label values must be properly
+# escaped strings; an unescaped '"' or newline breaks this regex and the
+# parser fails the page.
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+_SAMPLE_RE = re.compile(rf"^({_NAME})(\{{.*\}})? ([^ ]+)$")
+
+
+class ExpositionError(AssertionError):
+    pass
+
+
+def parse_exposition(text: str) -> dict:
+    """Strictly parse a text exposition; returns {base_name: {"type": ...,
+    "samples": [(full_name, labels_dict, value)]}}.  Raises
+    ExpositionError on any structural violation."""
+    families: dict[str, dict] = {}
+    current: str | None = None
+    seen_done: set[str] = set()  # families whose block has ended
+
+    def base_name(sample_name: str) -> str:
+        for fam, info in families.items():
+            if info["type"] == "histogram" and sample_name in (
+                f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"
+            ):
+                return fam
+        return sample_name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line) or _TYPE_RE.match(line)
+            if m is None:
+                raise ExpositionError(f"line {lineno}: bad comment {line!r}")
+            name = m.group(1)
+            key = "help" if line.startswith("# HELP") else "type"
+            if name in seen_done:
+                raise ExpositionError(
+                    f"line {lineno}: metadata for {name!r} after its block "
+                    f"ended (duplicate/ungrouped {key.upper()})"
+                )
+            fam = families.setdefault(name, {"type": None, "help": None, "samples": []})
+            if fam[key] is not None:
+                raise ExpositionError(
+                    f"line {lineno}: duplicate # {key.upper()} for {name!r}"
+                )
+            fam[key] = m.group(2)
+            if current is not None and current != name:
+                seen_done.add(current)
+            current = name
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ExpositionError(f"line {lineno}: unparsable sample {line!r}")
+        sample_name, labels_raw, value_raw = m.groups()
+        fam_name = base_name(sample_name)
+        if fam_name not in families:
+            raise ExpositionError(
+                f"line {lineno}: sample {sample_name!r} before its TYPE"
+            )
+        if fam_name in seen_done:
+            raise ExpositionError(
+                f"line {lineno}: sample of {fam_name!r} outside its block "
+                "(all series of one name must be grouped)"
+            )
+        if current != fam_name:
+            if current is not None:
+                seen_done.add(current)
+            current = fam_name
+        labels: dict[str, str] = {}
+        if labels_raw:
+            inner = labels_raw[1:-1]
+            matched = _LABEL_RE.findall(inner)
+            # Reconstruct to verify every byte of the label section parsed.
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
+            if rebuilt != inner:
+                raise ExpositionError(
+                    f"line {lineno}: malformed/unescaped labels {labels_raw!r}"
+                )
+            labels = dict(matched)
+        try:
+            value = float(value_raw)
+        except ValueError as e:
+            raise ExpositionError(f"line {lineno}: bad value {value_raw!r}") from e
+        families[fam_name]["samples"].append((sample_name, labels, value))
+
+    for name, fam in families.items():
+        if fam["type"] is None:
+            raise ExpositionError(f"{name!r} has samples but no TYPE")
+        if fam["type"] == "histogram":
+            _check_histogram(name, fam["samples"])
+    return families
+
+
+def _series_key(labels: dict) -> tuple:
+    return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+
+
+def _check_histogram(name: str, samples: list) -> None:
+    by_series: dict[tuple, dict] = {}
+    for sample_name, labels, value in samples:
+        entry = by_series.setdefault(
+            _series_key(labels), {"buckets": [], "sum": None, "count": None}
+        )
+        if sample_name == f"{name}_bucket":
+            le = labels.get("le")
+            if le is None:
+                raise ExpositionError(f"{name}: bucket without le label")
+            entry["buckets"].append((float("inf") if le == "+Inf" else float(le), value))
+        elif sample_name == f"{name}_sum":
+            entry["sum"] = value
+        elif sample_name == f"{name}_count":
+            entry["count"] = value
+    for key, entry in by_series.items():
+        buckets = entry["buckets"]
+        if not buckets:
+            raise ExpositionError(f"{name}{dict(key)}: histogram without buckets")
+        les = [le for le, _ in buckets]
+        if les != sorted(les):
+            raise ExpositionError(f"{name}{dict(key)}: le values not ascending")
+        if les[-1] != float("inf"):
+            raise ExpositionError(f"{name}{dict(key)}: missing +Inf bucket")
+        counts = [c for _, c in buckets]
+        if any(b < a for a, b in zip(counts, counts[1:])):
+            raise ExpositionError(
+                f"{name}{dict(key)}: non-monotonic cumulative bucket counts"
+            )
+        if entry["count"] is None or entry["sum"] is None:
+            raise ExpositionError(f"{name}{dict(key)}: missing _sum/_count")
+        if entry["count"] != counts[-1]:
+            raise ExpositionError(
+                f"{name}{dict(key)}: _count {entry['count']} != +Inf bucket "
+                f"{counts[-1]}"
+            )
+
+
+# --- parser self-tests (it must actually catch the failure modes) ----------
+
+
+def test_parser_rejects_duplicate_help_type():
+    bad = (
+        "# HELP m a\n# TYPE m counter\nm 1\n"
+        "# HELP m a\n# TYPE m counter\nm{x=\"y\"} 2\n"
+    )
+    with pytest.raises(ExpositionError, match="after its block|duplicate"):
+        parse_exposition(bad)
+
+
+def test_parser_rejects_ungrouped_samples():
+    bad = (
+        "# HELP a h\n# TYPE a counter\na 1\n"
+        "# HELP b h\n# TYPE b counter\nb 1\na 2\n"
+    )
+    with pytest.raises(ExpositionError, match="grouped"):
+        parse_exposition(bad)
+
+
+def test_parser_rejects_unescaped_label_quote():
+    bad = '# HELP m h\n# TYPE m counter\nm{x="a"b"} 1\n'
+    with pytest.raises(ExpositionError, match="label"):
+        parse_exposition(bad)
+
+
+def test_parser_rejects_non_monotonic_histogram():
+    bad = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\nh_bucket{le="+Inf"} 5\n'
+        "h_sum 1\nh_count 5\n"
+    )
+    with pytest.raises(ExpositionError, match="monotonic"):
+        parse_exposition(bad)
+
+
+# --- the fix itself: grouped HELP/TYPE for same-name labeled series --------
+
+
+def test_registry_groups_labeled_series_under_one_block():
+    r = metrics_lib.Registry()
+    for reason in ("alpha", "beta", "gamma"):
+        r.with_labels(shed_reason=reason).counter(
+            "kdlt_test_shed_total", "sheds by reason"
+        ).inc()
+    text = r.render()
+    assert text.count("# HELP kdlt_test_shed_total") == 1
+    assert text.count("# TYPE kdlt_test_shed_total") == 1
+    fams = parse_exposition(text)
+    assert len(fams["kdlt_test_shed_total"]["samples"]) == 3
+
+
+def test_registry_escapes_label_values_and_help():
+    r = metrics_lib.Registry()
+    r.with_labels(model='we"ird\nname\\x').counter("kdlt_test_total", "h\nelp")
+    fams = parse_exposition(r.render())
+    ((_, labels, _),) = fams["kdlt_test_total"]["samples"]
+    assert labels["model"] == 'we\\"ird\\nname\\\\x'  # escaped wire form
+
+
+# --- both live tiers' full /metrics pages ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def metrics_stack():
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    spec = register_spec(
+        ModelSpec(
+            name="expo-stub", family="xception",
+            input_shape=(16, 16, 3), labels=("a", "b"),
+        )
+    )
+    root = tempfile.mkdtemp(prefix="kdlt-expo-")
+    art.save_artifact(
+        art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        root, port=0, buckets=(1, 2), host="127.0.0.1", batcher_impl="python",
+        engine_factory=lambda a, **kw: StubEngine(a, async_device=True, **kw),
+    )
+    server.warmup()
+    server.start()
+    gateway = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name, port=0,
+        host="127.0.0.1",
+    )
+    gateway.start()
+    # Traffic so histograms/counters carry real observations (and the
+    # dispatcher's pipeline-stage series exist with samples).
+    img = np.zeros((1, 16, 16, 3), np.uint8)
+    requests.post(
+        f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict",
+        data=protocol.encode_predict_request(img),
+        headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+        timeout=30,
+    ).raise_for_status()
+    yield server, gateway
+    gateway.shutdown()
+    server.shutdown()
+
+
+def test_model_server_metrics_page_is_strictly_valid(metrics_stack):
+    server, _ = metrics_stack
+    text = requests.get(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=5
+    ).text
+    fams = parse_exposition(text)
+    # The admission shed counters are the same-name labeled family that
+    # used to render duplicate metadata blocks.
+    shed = fams["kdlt_admission_shed_total"]
+    assert len(shed["samples"]) >= 5
+    assert text.count("# TYPE kdlt_admission_shed_total") == 1
+    assert "kdlt_pipeline_readback_seconds" in fams
+
+
+def test_gateway_metrics_page_is_strictly_valid(metrics_stack):
+    _, gateway = metrics_stack
+    text = requests.get(
+        f"http://127.0.0.1:{gateway.port}/metrics", timeout=5
+    ).text
+    fams = parse_exposition(text)
+    assert "kdlt_gateway_request_seconds" in fams
+    assert text.count("# TYPE kdlt_admission_shed_total") == 1

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Static metrics-naming lint: every series is kdlt_-prefixed and minted
+through the central helpers in utils/metrics.py.
+
+The /metrics pages are the operational contract of both serving tiers;
+dashboards and alerts key on series names.  Two failure modes creep in as
+the tree grows: a module minting an un-prefixed name (invisible to every
+``kdlt_``-scoped dashboard query), and a module constructing Counter/
+Gauge/Histogram objects directly instead of going through a Registry or
+the helper functions (its series silently never reach /metrics, or reach
+it unlabeled).  This lint walks the AST of every production module and
+flags both.  Wired into tier-1 via tests/test_check_metrics.py.
+
+Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
+
+- every ``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
+  call must pass a string (or f-string with a literal head) starting with
+  ``kdlt_`` -- dynamic names with non-literal heads are flagged too, since
+  they cannot be audited statically;
+- Counter/Gauge/Histogram must not be instantiated directly outside
+  utils/metrics.py (the Registry mint methods are the only sanctioned
+  constructors -- they dedupe, label, and register).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "kubernetes_deep_learning_tpu"
+EXTRA_FILES = ("bench.py",)
+METRIC_PREFIX = "kdlt_"
+MINT_METHODS = {"counter", "gauge", "histogram"}
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+METRICS_MODULE = f"{PACKAGE}.utils.metrics"
+SKIP_PARTS = {"tfs_gen", "__pycache__"}
+
+
+def _literal_head(node: ast.expr) -> str | None:
+    """The statically-known head of a name argument: the whole string for
+    a constant, the leading constant of an f-string, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+def _name_arg(call: ast.Call) -> ast.expr | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def lint_source(src: str, rel: str) -> list[str]:
+    """Lint one module's source; returns violation strings."""
+    violations: list[str] = []
+    tree = ast.parse(src, filename=rel)
+    # Aliases under which this module can reach the metric classes.
+    metrics_module_aliases: set[str] = set()
+    metric_class_aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == METRICS_MODULE:
+                    metrics_module_aliases.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == METRICS_MODULE.rsplit(".", 1)[0]:
+                for a in node.names:
+                    if a.name == "metrics":
+                        metrics_module_aliases.add(a.asname or a.name)
+            elif node.module == METRICS_MODULE:
+                for a in node.names:
+                    if a.name in METRIC_CLASSES:
+                        metric_class_aliases.add(a.asname or a.name)
+
+    is_metrics_module = rel.replace(os.sep, "/").endswith("utils/metrics.py")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        # Direct Counter/Gauge/Histogram construction outside the central
+        # module (via `from ..utils.metrics import Histogram` or
+        # `metrics_lib.Histogram(...)`).
+        if not is_metrics_module and (
+            (isinstance(fn, ast.Name) and fn.id in metric_class_aliases)
+            or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in METRIC_CLASSES
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in metrics_module_aliases
+            )
+        ):
+            cls = fn.id if isinstance(fn, ast.Name) else fn.attr
+            violations.append(
+                f"{rel}:{node.lineno}: direct {cls}(...) construction; mint "
+                "through a Registry / the utils.metrics helpers instead"
+            )
+            continue
+        # Mint calls: .counter / .gauge / .histogram on anything (in this
+        # tree only Registry objects expose these method names).
+        if isinstance(fn, ast.Attribute) and fn.attr in MINT_METHODS:
+            arg = _name_arg(node)
+            if arg is None:
+                continue
+            head = _literal_head(arg)
+            if head is None:
+                violations.append(
+                    f"{rel}:{node.lineno}: .{fn.attr}() with a non-literal "
+                    "metric name; names must be statically auditable"
+                )
+            elif not head.startswith(METRIC_PREFIX):
+                violations.append(
+                    f"{rel}:{node.lineno}: metric name {head!r} is not "
+                    f"{METRIC_PREFIX}-prefixed"
+                )
+    return violations
+
+
+def iter_production_files() -> list[str]:
+    files: list[str] = [os.path.join(REPO, f) for f in EXTRA_FILES]
+    for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, PACKAGE)):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_PARTS]
+        files.extend(
+            os.path.join(dirpath, f) for f in sorted(filenames)
+            if f.endswith(".py")
+        )
+    return files
+
+
+def main() -> int:
+    violations: list[str] = []
+    for path in iter_production_files():
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            try:
+                violations.extend(lint_source(f.read(), rel))
+            except SyntaxError as e:
+                violations.append(f"{rel}: unparsable: {e}")
+    for v in violations:
+        print(v)
+    if not violations:
+        print("check_metrics: all metric names kdlt_-prefixed and centrally minted")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
