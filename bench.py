@@ -1213,6 +1213,223 @@ def bench_pipeline_ab(n_batches=150, batch=16, host_ms=3.0, device_ms=10.0,
     return out, 0 if ok else 1
 
 
+_CROSSHOST_AB_WORKER = r"""
+import json, os, sys, time
+from collections import deque
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+from kubernetes_deep_learning_tpu.utils.platform import force_platform
+force_platform("cpu")
+from kubernetes_deep_learning_tpu.utils.distributed import initialize
+assert initialize(), "env triplet must trigger jax.distributed.initialize"
+import jax
+import numpy as np
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostForward
+from kubernetes_deep_learning_tpu.models import init_variables
+
+cfg = json.loads(sys.argv[1])
+spec = register_spec(ModelSpec(
+    name="xh-bench", family="vit-tiny", input_shape=(32, 32, 3),
+    labels=("a", "b", "c", "d"), preprocessing="tf",
+))
+variables = init_variables(spec, seed=7)  # same seed -> identical everywhere
+mesh = make_mesh(len(jax.devices()), devices=jax.devices())
+xh = CrossHostForward(
+    spec, mesh, variables, buckets=(cfg["batch"],),
+    pipeline_depth=max(cfg["depths"]),
+)
+
+if jax.process_index() != 0:
+    xh.follower_loop()
+    sys.exit(0)
+
+rng = np.random.default_rng(cfg["seed"])
+ring = [
+    rng.integers(0, 256, (cfg["batch"], *spec.input_shape), np.uint8)
+    for _ in range(8)
+]
+xh.predict(ring[0])  # compile round (off the clock)
+
+host_ms = cfg["host_ms"]
+if host_ms <= 0:
+    # Calibrate the simulated per-round host work (the batcher's
+    # assembly/decode stand-in) to the measured round time, the regime
+    # where overlap matters most: pipelined wall ~= max(host, round)
+    # while lockstep pays host + round.
+    t0 = time.perf_counter()
+    for i in range(10):
+        xh.predict(ring[i % len(ring)])
+    host_ms = 1e3 * (time.perf_counter() - t0) / 10
+
+def run_arm(depth):
+    outs = [None] * cfg["rounds"]
+    lat = []
+    pending = deque()  # (t_submit, handle, n, i)
+
+    def complete_oldest():
+        t_sub, h, n, i = pending.popleft()
+        outs[i] = np.asarray(h)[:n]
+        lat.append(time.perf_counter() - t_sub)
+
+    t_start = time.perf_counter()
+    for i in range(cfg["rounds"]):
+        time.sleep(host_ms / 1e3)  # simulated host assembly for round i
+        if depth == 0:  # pure lockstep reference: the synchronous API
+            t_sub = time.perf_counter()
+            outs[i] = xh.predict(ring[i % len(ring)])
+            lat.append(time.perf_counter() - t_sub)
+            continue
+        t_sub = time.perf_counter()
+        h, n = xh.predict_async(ring[i % len(ring)])
+        pending.append((t_sub, h, n, i))
+        while len(pending) >= depth:
+            complete_oldest()
+    while pending:
+        complete_oldest()
+    wall = time.perf_counter() - t_start
+    lat_ms = sorted(1e3 * x for x in lat)
+    return outs, {
+        "wall_s": round(wall, 3),
+        "img_per_s": round(cfg["rounds"] * cfg["batch"] / wall, 1),
+        "p50_ms": round(lat_ms[len(lat_ms) // 2], 2),
+        "p95_ms": round(lat_ms[int(len(lat_ms) * 0.95)], 2),
+    }
+
+arms = {}
+outs_by_arm = {}
+outs_by_arm["lockstep"], arms["lockstep"] = run_arm(0)
+for d in cfg["depths"]:
+    outs_by_arm[f"depth{d}"], arms[f"depth{d}"] = run_arm(d)
+xh.shutdown()
+
+ref = outs_by_arm["lockstep"]
+identical = {
+    name: all(np.array_equal(a, b) for a, b in zip(ref, outs))
+    for name, outs in outs_by_arm.items()
+}
+print("CROSSHOST-AB " + json.dumps({
+    "host_ms": round(host_ms, 3),
+    "arms": arms,
+    "identical_to_lockstep": identical,
+}), flush=True)
+"""
+
+
+def bench_crosshost_ab(n_rounds=60, batch=32, host_ms=0.0, processes=2,
+                       depths=(1, 2), seed=0, speedup_floor=1.15):
+    """Pipelined vs lockstep CROSS-HOST dispatch on a real multi-process
+    CPU fleet (utils.distributed + Gloo collectives, no device needed).
+
+    Spawns ``processes`` python processes that join one jax runtime (the
+    same env-triplet bring-up tests/test_crosshost.py uses), shards one
+    model over all of them, and drives the leader through three arms over
+    the identical round sequence:
+
+    - ``lockstep``: the synchronous predict() API -- broadcast, collective,
+      readback fully materialized per round (the pre-round-5 cadence);
+    - ``depth1``: predict_async at in-flight budget 1 -- must reproduce
+      lockstep timing AND logits exactly (the safe fallback);
+    - ``depthN``: the pipelined path -- round N+1's simulated host
+      assembly (``host_ms``; 0 calibrates it to the measured round time)
+      overlaps round N's collective execution.
+
+    rc=0 iff every arm's logits are bit-identical to lockstep and the
+    deepest arm's throughput is >= ``speedup_floor`` x lockstep.
+    """
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = {
+        "rounds": n_rounds, "batch": batch, "host_ms": host_ms,
+        "depths": sorted(set(depths)), "seed": seed,
+    }
+    env_base = {
+        **os.environ,
+        "KDLT_COORDINATOR": f"127.0.0.1:{port}",
+        "KDLT_NUM_PROCESSES": str(processes),
+        "KDLT_DIST_INIT_TIMEOUT_S": os.environ.get(
+            "KDLT_DIST_INIT_TIMEOUT_S", "120"
+        ),
+        # Followers size their in-flight budget from the env (the leader
+        # constructs explicitly); every process must agree, like any other
+        # fleet-wide config.
+        "KDLT_XH_PIPELINE_DEPTH": str(max(cfg["depths"])),
+    }
+    env_base.pop("JAX_PLATFORMS", None)
+    log(
+        f"cross-host A/B: {processes}-process CPU fleet, {n_rounds} rounds "
+        f"of batch {batch} per arm, depths {cfg['depths']} "
+        f"(host_ms {'auto' if host_ms <= 0 else host_ms})"
+    )
+    procs = []
+    for pid in range(processes):
+        env = {**env_base, "KDLT_PROCESS_ID": str(pid)}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CROSSHOST_AB_WORKER, json.dumps(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
+        ))
+    outputs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return {"metric": "cross-host A/B", "error": "fleet timed out"}, 1
+        outputs.append((p.returncode, out))
+    for rc, out in outputs:
+        if rc != 0:
+            return {
+                "metric": "cross-host A/B",
+                "error": f"worker rc {rc}: {out[-2000:]}",
+            }, 1
+    line = next(
+        (ln for ln in outputs[0][1].splitlines()[::-1]
+         if ln.startswith("CROSSHOST-AB ")), None,
+    )
+    if line is None:
+        return {
+            "metric": "cross-host A/B",
+            "error": f"no result line: {outputs[0][1][-2000:]}",
+        }, 1
+    res = json.loads(line[len("CROSSHOST-AB "):])
+    arms, identical = res["arms"], res["identical_to_lockstep"]
+    deepest = f"depth{max(cfg['depths'])}"
+    speedup = arms[deepest]["img_per_s"] / arms["lockstep"]["img_per_s"]
+    for name, row in arms.items():
+        log(
+            f"  {name:>9}: {row['img_per_s']:8.1f} img/s "
+            f"(wall {row['wall_s']:6.3f}s, p50 {row['p50_ms']:6.2f}ms)"
+            + ("" if identical.get(name, False) else "  LOGITS DIVERGE")
+        )
+    ok = all(identical.values()) and speedup >= speedup_floor
+    out = {
+        "metric": (
+            f"pipelined cross-host dispatch A/B ({processes}-process CPU "
+            f"fleet, {n_rounds} rounds of batch {batch}, simulated host "
+            f"work {res['host_ms']}ms/round): {deepest} throughput over "
+            "lockstep; logits "
+            + ("bit-identical across arms" if all(identical.values())
+               else "NOT identical")
+        ),
+        "value": round(speedup, 3),
+        "unit": "x img/s over lockstep",
+        "vs_baseline": round(speedup, 3),
+        "host_ms": res["host_ms"],
+        "identical_to_lockstep": identical,
+        "p50_delta_ms": round(
+            arms[deepest]["p50_ms"] - arms["lockstep"]["p50_ms"], 2
+        ),
+        "arms": arms,
+    }
+    return out, 0 if ok else 1
+
+
 def bench_overload_ab(duration_s=8.0, device_ms=100.0, deadline_ms=600.0,
                       rate_x=2.0, buckets=(1, 2), max_delay_ms=2.0):
     """Admission control A/B under overload: goodput with vs without.
@@ -2122,6 +2339,32 @@ def main() -> int:
         help="bucket ladder for the --overload-ab stub tier",
     )
     p.add_argument(
+        "--crosshost-ab", type=int, default=0, metavar="ROUNDS",
+        help="INSTEAD of the sweep: pipelined vs lockstep cross-host "
+             "dispatch A/B on a real multi-process CPU fleet "
+             "(utils.distributed; no device needed) -- drive this many "
+             "rounds per arm and report img/s + p50 per arm (rc=0 iff the "
+             "pipelined arm's throughput is >= 1.15x lockstep with "
+             "bit-identical logits and depth 1 reproduces lockstep)",
+    )
+    p.add_argument(
+        "--crosshost-ab-batch", type=int, default=32,
+        help="images per round for --crosshost-ab",
+    )
+    p.add_argument(
+        "--crosshost-ab-host-ms", type=float, default=0.0,
+        help="simulated per-round host assembly ms for --crosshost-ab "
+             "(0 = calibrate to the measured round time)",
+    )
+    p.add_argument(
+        "--crosshost-ab-processes", type=int, default=2,
+        help="fleet size for --crosshost-ab (>= 2 for a real cross-host path)",
+    )
+    p.add_argument(
+        "--crosshost-ab-depths", default="1,2",
+        help="comma-separated in-flight round budgets for --crosshost-ab",
+    )
+    p.add_argument(
         "--chaos-ab", type=float, default=0, metavar="SECONDS",
         help="INSTEAD of the sweep: serving-path fault-tolerance A/B -- "
              "front two stub model-tier replicas with the real gateway, "
@@ -2216,9 +2459,9 @@ def main() -> int:
         # The resolved configuration the run WOULD use, on one parsable
         # line; no jax import, no device dial, no subprocesses.
         mode = "sweep"
-        for flag in ("soak", "child_batch", "pipeline_ab", "batcher_sweep",
-                     "host_saturation", "overload_ab", "chaos_ab",
-                     "trace_breakdown"):
+        for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
+                     "batcher_sweep", "host_saturation", "overload_ab",
+                     "chaos_ab", "trace_breakdown"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -2251,6 +2494,15 @@ def main() -> int:
             "trace": {
                 "requests": args.trace_breakdown,
                 "device_ms": args.trace_device_ms,
+            },
+            "crosshost": {
+                "rounds": args.crosshost_ab,
+                "batch": args.crosshost_ab_batch,
+                "host_ms": args.crosshost_ab_host_ms,
+                "processes": args.crosshost_ab_processes,
+                "depths": [
+                    int(d) for d in args.crosshost_ab_depths.split(",")
+                ],
             },
         }), flush=True)
         return 0
@@ -2293,6 +2545,17 @@ def main() -> int:
             host_ms=args.pipeline_ab_host_ms,
             device_ms=args.pipeline_ab_device_ms,
             depths=tuple(int(d) for d in args.pipeline_ab_depths.split(",")),
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.crosshost_ab > 0:
+        out, rc = bench_crosshost_ab(
+            n_rounds=args.crosshost_ab,
+            batch=args.crosshost_ab_batch,
+            host_ms=args.crosshost_ab_host_ms,
+            processes=args.crosshost_ab_processes,
+            depths=tuple(int(d) for d in args.crosshost_ab_depths.split(",")),
         )
         print(json.dumps(out), flush=True)
         return rc

@@ -288,8 +288,14 @@ def flash_attention(
 
     # Inside shard_map, outputs must declare which mesh axes they vary over
     # (check_vma); propagate the query's vma so the kernel composes with
-    # parallel.ring.  Outside shard_map this is the empty set / None.
-    vma = getattr(jax.typeof(qf), "vma", None)
+    # parallel.ring.  Outside shard_map (or on a pre-vma JAX) this is the
+    # empty set / None.
+    from kubernetes_deep_learning_tpu.utils.jaxcompat import (
+        shape_dtype_struct,
+        typeof,
+    )
+
+    vma = getattr(typeof(qf), "vma", None)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
@@ -317,9 +323,9 @@ def flash_attention(
                 row_spec,
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32, vma=vma),
-                jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32, vma=vma),
-                jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32, vma=vma),
+                shape_dtype_struct((b * h, sq, d), jnp.float32, vma=vma),
+                shape_dtype_struct((b * h, sq, 1), jnp.float32, vma=vma),
+                shape_dtype_struct((b * h, sq, 1), jnp.float32, vma=vma),
             ],
             interpret=interpret,
         )(qf, kf, vf)
@@ -338,7 +344,7 @@ def flash_attention(
         grid=grid,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda g, i: (g, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
+        out_shape=shape_dtype_struct((b * h, sq, d), q.dtype, vma=vma),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d)
@@ -413,7 +419,9 @@ def attention_serving(q, k, v, *, causal: bool = False):
     sq, sk = q.shape[2], k.shape[2]
     if use_einsum_attention(sq, sk) or not _HAVE_PALLAS:
         return mha_reference(q, k, v, causal=causal)
-    return jax.lax.platform_dependent(
+    from kubernetes_deep_learning_tpu.utils.jaxcompat import platform_dependent
+
+    return platform_dependent(
         q, k, v,
         tpu=functools.partial(
             flash_attention_padded, causal=causal, interpret=False
@@ -459,7 +467,9 @@ def _forward_with_lse(q, k, v, causal: bool):
 
     if block_q is None or block_k is None or not _HAVE_PALLAS:
         return via_reference(q, k, v)
-    return jax.lax.platform_dependent(
+    from kubernetes_deep_learning_tpu.utils.jaxcompat import platform_dependent
+
+    return platform_dependent(
         q, k, v, tpu=via_flash, default=via_reference
     )
 

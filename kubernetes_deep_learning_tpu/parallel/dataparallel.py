@@ -43,12 +43,39 @@ def param_partition_spec(path: tuple, arr, model_parallel: int) -> P:
 
 
 def shard_variables(variables: Any, mesh: Mesh) -> Any:
-    """device_put variables with the partition rules applied."""
+    """device_put variables with the partition rules applied.
+
+    On a mesh spanning multiple PROCESSES, each leaf is assembled from
+    per-local-device puts (make_array_from_single_device_arrays) instead
+    of one cross-process device_put: every process already holds the full
+    host tree (identical artifact/seed), and a device_put against
+    non-addressable devices runs a hidden cross-process assert_equal
+    collective per leaf on some jax versions -- a boot-time broadcast of
+    the whole parameter tree over DCN at best, and on the Gloo CPU
+    backend a hard crash (concurrent per-leaf collective programs
+    corrupt the shared TCP pairs).  Local meshes keep the plain (batched,
+    fast) device_put.
+    """
     model_parallel = mesh.shape[MODEL_AXIS]
+    me = jax.process_index()
+    multiprocess = any(d.process_index != me for d in mesh.devices.flat)
+    local_devices = [d for d in mesh.devices.flat if d.process_index == me]
 
     def put(path, arr):
         spec = param_partition_spec(path, arr, model_parallel)
-        return jax.device_put(arr, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if not multiprocess:
+            return jax.device_put(arr, sharding)
+        arr = np.asarray(arr)
+        imap = sharding.devices_indices_map(arr.shape)
+        return jax.make_array_from_single_device_arrays(
+            arr.shape,
+            sharding,
+            [
+                jax.device_put(np.ascontiguousarray(arr[imap[d]]), d)
+                for d in local_devices
+            ],
+        )
 
     return jax.tree_util.tree_map_with_path(put, variables)
 
@@ -69,7 +96,10 @@ def resolve_sharded_fast(spec: ModelSpec, mesh: Mesh, dtype: Any, fast) -> bool:
     return resolve_fast(spec, dtype, fast, backend=platform)
 
 
-def build_sharded_jit(spec: ModelSpec, mesh: Mesh, dtype: Any, fast: bool):
+def build_sharded_jit(
+    spec: ModelSpec, mesh: Mesh, dtype: Any, fast: bool,
+    replicate_out: bool = False, chain_token: bool = False,
+):
     """The raw jitted SPMD forward over the mesh (no host device_put).
 
     ``fast`` is a RESOLVED bool (callers gate through resolve_sharded_fast).
@@ -77,24 +107,65 @@ def build_sharded_jit(spec: ModelSpec, mesh: Mesh, dtype: Any, fast: bool):
     executes the SAME program single-chip serving runs, on its local batch
     shard.  fast=False jits the flax graph with sharding annotations and
     XLA inserts the collectives.  Shared by build_sharded_forward (local
-    meshes) and parallel.crosshost (lockstep multi-host rounds), so there
-    is exactly one definition of what mesh serving executes.
+    meshes) and parallel.crosshost (multi-host rounds), so there is exactly
+    one definition of what mesh serving executes.
+
+    ``replicate_out=True`` makes the logits FULLY REPLICATED instead of
+    data-sharded: the all-gather happens ON DEVICE inside this program
+    (ICI within a slice, DCN across), so every process can read the whole
+    output from its local shards with a plain ``np.asarray`` -- no
+    host-side collective at readback.  This is half of what makes
+    cross-host dispatch pipelinable (parallel.crosshost).
+
+    ``chain_token=True`` changes the signature to
+    ``f(variables, images, token) -> (logits, token + 1)`` with ``token``
+    a replicated f32 scalar array.  Feeding round N's token output into
+    round N+1's call makes the runtime start executing N+1 only after N
+    has completed -- on EVERY process, in the same order -- which is the
+    other half of pipelining safety: two overlapped rounds' collectives
+    can never interleave on the inter-process transport (the CPU Gloo
+    backend matches collective ops by wire order per TCP pair, so
+    concurrently executing collective programs corrupt each other; real
+    TPU cores execute FIFO per core, where the token is a no-op).  The
+    host side stays fully asynchronous -- only device EXECUTION serializes,
+    and the device runs one program at a time anyway.
     """
+    from kubernetes_deep_learning_tpu.utils.jaxcompat import shard_map
+
+    out_spec = P() if replicate_out else P(DATA_AXIS)
     if fast:
         inner = build_forward(spec, dtype=dtype, fast=True)
         # check_vma=False: pallas_call out_shapes do not declare varying
         # mesh axes, and the data flow here is trivially per-shard.
-        return jax.jit(
-            jax.shard_map(
-                inner,
-                mesh=mesh,
-                in_specs=(P(), P(DATA_AXIS)),  # params replicated; batch sharded
-                out_specs=P(DATA_AXIS),
-                check_vma=False,
-            )
+        forward = shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS)),  # params replicated; batch sharded
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
         )
-    forward = build_forward(spec, dtype=dtype, fast=False)
-    return jax.jit(forward, out_shardings=NamedSharding(mesh, P(DATA_AXIS)))
+    else:
+        forward = build_forward(spec, dtype=dtype, fast=False)
+    if not chain_token:
+        return jax.jit(forward, out_shardings=NamedSharding(mesh, out_spec))
+
+    def chained(variables, images, token):
+        # The barrier makes the BATCH (and hence every collective, which
+        # all transitively consume it) data-depend on the token: without
+        # it the runtime's op-level scheduler would start round N+1's
+        # collectives -- which need only the batch -- while round N still
+        # runs, exactly the wire interleaving the token exists to forbid.
+        # An output-side dependency alone gates nothing.
+        images, token = jax.lax.optimization_barrier((images, token))
+        return forward(variables, images), token + 1.0
+
+    return jax.jit(
+        chained,
+        out_shardings=(
+            NamedSharding(mesh, out_spec),
+            NamedSharding(mesh, P()),
+        ),
+    )
 
 
 def build_sharded_forward(

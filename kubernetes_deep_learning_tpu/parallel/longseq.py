@@ -28,11 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_deep_learning_tpu.utils.jaxcompat import shard_map
 
 from kubernetes_deep_learning_tpu.models.vit import VIT_CONFIGS, ViTConfig
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec

@@ -26,11 +26,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_deep_learning_tpu.utils.jaxcompat import shard_map
 
 from kubernetes_deep_learning_tpu.ops.attention import (
     NEG_INF,

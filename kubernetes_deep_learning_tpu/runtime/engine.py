@@ -148,7 +148,22 @@ class InFlightDispatcher:
         self._closed = False
         self._close_lock = threading.Lock()
         registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
-        self._m_stage = metrics_lib.pipeline_stage_histograms(registry)
+        # Engines that are themselves a pipeline front (the cross-host
+        # round protocol) label their stage series so dashboards separate
+        # per-chip dispatch from fleet rounds; plain engines keep the
+        # unlabeled single-host series.
+        self._m_stage = metrics_lib.pipeline_stage_histograms(
+            registry, engine=getattr(engine, "pipeline_engine_label", None)
+        )
+        # Trace-aware engines (CrossHostEngine) take the member requests'
+        # RequestTrace carriers through predict_async and record their own
+        # protocol spans (crosshost.*) under the same waterfall the
+        # pipeline-stage spans land in.
+        import inspect as _inspect
+
+        self._async_takes_traces = "traces" in _inspect.signature(
+            engine.predict_async
+        ).parameters if hasattr(engine, "predict_async") else False
         self._m_depth = registry.gauge(
             "kdlt_pipeline_depth", "configured in-flight dispatch depth"
         )
@@ -231,7 +246,10 @@ class InFlightDispatcher:
         try:
             if self._faults is not None:
                 self._faults.fire("dispatch.submit")
-            handle, n = self._engine.predict_async(images)
+            if self._async_takes_traces:
+                handle, n = self._engine.predict_async(images, traces=traces)
+            else:
+                handle, n = self._engine.predict_async(images)
         except Exception as e:  # dispatch failure belongs to THIS future
             self._slots.release()
             fut.set_exception(e)

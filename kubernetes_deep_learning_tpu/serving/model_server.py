@@ -125,8 +125,13 @@ class ServedModel:
             # both draw from the same bounded in-flight budget (the device
             # runs one program at a time regardless of which path enqueued
             # it).  None when depth=1 (serial) or the engine has no async
-            # dispatch hook (e.g. the plain StubEngine).
-            depth = resolve_pipeline_depth(pipeline_depth)
+            # dispatch hook (e.g. the plain StubEngine).  An engine that
+            # carries its own budget (CrossHostEngine: the fleet-wide
+            # KDLT_XH_PIPELINE_DEPTH) overrides the per-chip default so the
+            # dispatcher's backpressure matches the protocol's.
+            depth = getattr(self.engine, "preferred_pipeline_depth", None)
+            if depth is None:
+                depth = resolve_pipeline_depth(pipeline_depth)
             self.dispatcher = (
                 InFlightDispatcher(
                     self.engine, depth=depth, registry=self.registry_child

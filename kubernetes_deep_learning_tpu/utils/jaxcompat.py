@@ -1,0 +1,133 @@
+"""Version compatibility shims over the moving parts of the JAX API.
+
+The framework is written against the current JAX surface (``jax.shard_map``
+with ``check_vma``, ``jax.typeof``), but deployment images pin whatever
+jaxlib their accelerator stack ships -- which can lag by several minor
+versions (this container bakes 0.4.x).  Rather than sprinkling
+``try/except ImportError`` at every call site (and silently drifting as
+sites are added), every use of an API that has moved or been renamed goes
+through here, so exactly one module knows the version matrix:
+
+- ``shard_map``: lived in ``jax.experimental.shard_map`` until ~0.8, then
+  graduated to ``jax.shard_map``; its replication-checking kwarg was
+  renamed ``check_rep`` -> ``check_vma`` in the same window.  The shim
+  accepts the NEW spelling and translates down.
+- ``typeof``: ``jax.typeof`` (the aval, carrying ``.vma`` inside
+  shard_map) appeared ~0.6; older versions reach the same aval through
+  ``jax.core.get_aval`` (which simply has no ``vma`` attribute -- callers
+  already treat "no vma" as the not-inside-shard_map case).
+- ``enable_cpu_collectives``: pre-0.5 jaxlib does not select the Gloo
+  CPU collectives backend by default, so a multi-process CPU fleet dies
+  with "Multiprocess computations aren't implemented on the CPU backend"
+  unless the config flag is set before ``jax.distributed.initialize``.
+  Newer versions default to Gloo and have dropped the flag; the shim is a
+  no-op there.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+
+def _resolve_shard_map():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:  # pre-graduation spelling
+        from jax.experimental.shard_map import shard_map as fn
+    return fn
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` under either API generation.
+
+    Callers pass the current (``check_vma``) spelling; on a JAX whose
+    shard_map still takes ``check_rep`` the flag is translated (the
+    semantics -- trace-time validation of output replication/varying-axes
+    declarations -- are the same feature under both names).
+    """
+    fn = _resolve_shard_map()
+    params = inspect.signature(fn).parameters
+    kwargs: dict[str, Any] = {
+        "mesh": mesh, "in_specs": in_specs, "out_specs": out_specs,
+    }
+    if "check_vma" in params:
+        kwargs["check_vma"] = check_vma
+    elif "check_rep" in params:
+        kwargs["check_rep"] = check_vma
+    return fn(f, **kwargs)
+
+
+def typeof(x):
+    """``jax.typeof`` (>= ~0.6) or the equivalent aval lookup.
+
+    The pre-typeof aval has no ``vma`` attribute; callers that read it via
+    ``getattr(..., "vma", None)`` get the same None they would outside a
+    shard_map -- which is the correct degenerate answer on a JAX too old
+    to track varying mesh axes at all.
+    """
+    import jax
+
+    fn = getattr(jax, "typeof", None)
+    if fn is not None:
+        return fn(x)
+    from jax import core
+
+    return core.get_aval(x)
+
+
+def platform_dependent(*args, default, **branches):
+    """``jax.lax.platform_dependent`` that survives pre-pruning JAX.
+
+    Modern JAX prunes the per-platform branches down to the platforms a
+    computation is actually being lowered for, so a Pallas-TPU branch
+    inside a CPU lowering is simply dropped.  Older versions lower EVERY
+    branch, and the Pallas CPU lowering rule raises ("Only interpret mode
+    is supported on CPU backend") for a branch that could never run.  On
+    those versions the branch is resolved at TRACE time from the process
+    default backend instead -- the one capability lost is baking multiple
+    platforms' branches into a single exported module (the exporter's
+    multi-platform artifacts then carry the portable default branch for
+    non-default platforms, which is numerically identical, just not
+    fused).
+    """
+    import jax
+
+    if hasattr(jax, "typeof"):  # same generation as branch pruning
+        return jax.lax.platform_dependent(*args, default=default, **branches)
+    fn = branches.get(jax.default_backend(), default)
+    return fn(*args)
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` with the ``vma`` kwarg where supported.
+
+    On a pre-vma JAX, ``vma`` is dropped: those versions do not track
+    varying mesh axes at all, so there is nothing to declare (and the
+    caller's ``vma`` is necessarily None there -- ``typeof`` above cannot
+    produce one).
+    """
+    import jax
+
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # pre-vma signature
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def enable_cpu_collectives() -> None:
+    """Select the Gloo CPU collectives backend where it is not the default.
+
+    Must run BEFORE ``jax.distributed.initialize`` touches the backend.
+    On JAX versions where the option has been removed (Gloo became the
+    only/default CPU implementation) this is a silent no-op.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # option gone: Gloo is the default
+        pass

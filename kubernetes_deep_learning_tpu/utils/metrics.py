@@ -47,18 +47,70 @@ PIPELINE_STAGES = (
 )
 
 
-def pipeline_stage_histograms(registry: "Registry") -> dict:
+def pipeline_stage_histograms(
+    registry: "Registry", engine: str | None = None
+) -> dict:
     """The per-stage histograms every in-flight dispatcher emits.
 
     Centralized so the dispatcher, the bench A/B mode, and any future
     pipelined caller emit the SAME series names (kdlt_pipeline_<stage>_seconds)
-    and dashboards/alerts need one set of queries.
+    and dashboards/alerts need one set of queries.  ``engine`` labels the
+    series (engine="crosshost" for the cross-host dispatch pipeline) so
+    one dashboard separates per-chip dispatch from fleet rounds; None
+    keeps the unlabeled single-host series.
     """
+    if engine:
+        registry = registry.with_labels(engine=engine)
     return {
         stage: registry.histogram(
             f"kdlt_pipeline_{stage}_seconds", help, buckets=PIPELINE_STAGE_BUCKETS
         )
         for stage, help in PIPELINE_STAGES
+    }
+
+
+def crosshost_metrics(registry: "Registry") -> dict:
+    """The cross-host round series (kdlt_crosshost_*), one set per serving
+    engine/version (parallel.crosshost.CrossHostForward.attach_metrics).
+
+    Centralized like pipeline_stage_histograms so the leader, bench.py
+    --crosshost-ab, and dashboards key one set of names.  Stage semantics
+    mirror the round protocol: ``broadcast`` is the leader's DCN
+    control+payload broadcast (host-blocking, the part pipelining
+    overlaps), ``collective`` is dispatch->device-completion of the SPMD
+    program (execution incl. the on-device logits all-gather), ``gather``
+    is the leader-local D2H materialization.
+    """
+    return {
+        "depth": registry.gauge(
+            "kdlt_crosshost_pipeline_depth",
+            "configured cross-host in-flight round budget (KDLT_XH_PIPELINE_DEPTH)",
+        ),
+        "inflight": registry.gauge(
+            "kdlt_crosshost_inflight_rounds",
+            "rounds broadcast+dispatched but not yet materialized",
+        ),
+        "rounds": registry.counter(
+            "kdlt_crosshost_rounds_total", "cross-host predict rounds dispatched"
+        ),
+        "reloads": registry.counter(
+            "kdlt_crosshost_reload_total", "fleet-wide RELOAD rounds broadcast"
+        ),
+        "broadcast": registry.histogram(
+            "kdlt_crosshost_broadcast_seconds",
+            "leader DCN control+payload broadcast per round",
+            buckets=PIPELINE_STAGE_BUCKETS,
+        ),
+        "collective": registry.histogram(
+            "kdlt_crosshost_collective_seconds",
+            "round dispatch -> device completion (SPMD execution incl. "
+            "on-device logits all-gather; overlapped under pipelining)",
+        ),
+        "gather": registry.histogram(
+            "kdlt_crosshost_gather_seconds",
+            "leader-local D2H materialization of a round's replicated logits",
+            buckets=PIPELINE_STAGE_BUCKETS,
+        ),
     }
 
 

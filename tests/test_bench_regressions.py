@@ -16,6 +16,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _BENCH = os.path.join(_REPO, "bench.py")
 
@@ -128,6 +130,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--batcher-sweep", "5"], "batcher_sweep"),
         (["--overload-ab", "6"], "overload_ab"),
         (["--chaos-ab", "6"], "chaos_ab"),
+        (["--crosshost-ab", "30"], "crosshost_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -177,6 +180,43 @@ def test_dry_run_chaos_ab_echoes_the_fault_tolerance_config():
     assert out["chaos"]["probe_s"] == 0.25
     assert out["chaos"]["seed"] == 7
     assert out["chaos"]["deadline_ms"] == 2000.0
+
+
+def test_dry_run_crosshost_ab_echoes_the_pipeline_config():
+    # The --crosshost-ab invocation surface (the cross-host dispatch
+    # pipelining acceptance harness, ISSUE 5) must keep parsing and echo
+    # its resolved knobs without importing jax or spawning the fleet.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--crosshost-ab", "40", "--dry-run",
+         "--crosshost-ab-batch", "16", "--crosshost-ab-processes", "3",
+         "--crosshost-ab-depths", "1,2,4", "--crosshost-ab-host-ms", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "crosshost_ab"
+    assert out["crosshost"]["rounds"] == 40
+    assert out["crosshost"]["batch"] == 16
+    assert out["crosshost"]["processes"] == 3
+    assert out["crosshost"]["depths"] == [1, 2, 4]
+    assert out["crosshost"]["host_ms"] == 5.0
+
+
+@pytest.mark.slow
+def test_crosshost_ab_pipelined_beats_lockstep():
+    """The tentpole's acceptance bar on a REAL 2-process fleet (slow:
+    spawns a fleet + compiles): pipelined >= 1.15x lockstep img/s with
+    bit-identical logits, depth 1 == lockstep.  Serialized behind the
+    fleet flock like every multi-process test."""
+    from tests.test_crosshost import _fleet_lock
+
+    bench = _bench_module()
+    with _fleet_lock():
+        out, rc = bench.bench_crosshost_ab(n_rounds=40, batch=32)
+    assert rc == 0, out
+    assert all(out["identical_to_lockstep"].values()), out
+    assert out["value"] >= 1.15, out
 
 
 # --- the pipelined-vs-serial A/B acceptance bound -------------------------

@@ -1,0 +1,442 @@
+"""Pipelined cross-host dispatch (ISSUE 5): in-flight budget, stall
+detection, lockstep equivalence, and the serving-path wiring.
+
+Two tiers of tests:
+
+- single-process (a "fleet" of one -- jax.process_count() == 1 skips the
+  control channel but exercises the whole pipelined round path: in-flight
+  ledger, budget semaphore, watch wiring, handle materialization);
+- a real 2-process fleet (same env-triplet bring-up as test_crosshost.py)
+  proving pipelined logits are BIT-IDENTICAL to lockstep across bucket
+  changes and a mid-stream RELOAD, and that a follower whose round wedges
+  exits 70 (its own stall detection, not the leader's).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_crosshost import _run_fleet, _run_fleet_raw
+
+
+@pytest.fixture(scope="module")
+def xh_pair():
+    """One CrossHostForward (depth 4 -- the deepest the tests drive) plus
+    its reference forward, shared across this module's single-process
+    tests (construction compiles the SPMD program: seconds on CPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostForward
+    from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+
+    spec = register_spec(
+        ModelSpec(
+            name="xh-pipe-test",
+            family="vit-tiny",
+            input_shape=(16, 16, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+        )
+    )
+    variables = init_variables(spec, seed=11)
+    mesh = make_mesh(8, devices=jax.devices())
+    xh = CrossHostForward(spec, mesh, variables, buckets=(4, 8), pipeline_depth=4)
+    ref = jax.jit(build_forward(spec, dtype=jnp.bfloat16, fast=False))
+    return xh, ref, variables
+
+
+class _GatedArray:
+    """Stands in for a dispatched device array whose completion the test
+    controls: block_until_ready() blocks until released."""
+
+    def __init__(self, value: np.ndarray):
+        self._value = value
+        self._event = threading.Event()
+
+    def release(self):
+        self._event.set()
+
+    def block_until_ready(self):
+        assert self._event.wait(timeout=30.0), "gated round never released"
+        return self
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._value, dtype=dtype)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_inflight_budget_respected(xh_pair, monkeypatch, depth):
+    """predict_async admits at most ``depth`` unmaterialized rounds; the
+    next submit blocks until one completes (the backpressure contract),
+    at depth 1/2/4."""
+    xh, _ref, _v = xh_pair
+    monkeypatch.setattr(xh, "pipeline_depth", depth)
+    monkeypatch.setattr(xh, "_slots", threading.Semaphore(depth))
+    gates = []
+    logits = np.zeros((8, 3), np.float32)
+    monkeypatch.setattr(
+        xh, "_dispatch_round",
+        lambda batch, fast=False: gates.append(_GatedArray(logits)) or gates[-1],
+    )
+    images = np.zeros((8, 16, 16, 3), np.uint8)
+
+    handles = [xh.predict_async(images) for _ in range(depth)]
+    assert xh.inflight_rounds == depth
+
+    blocked_result = []
+
+    def over_budget():
+        blocked_result.append(xh.predict_async(images))
+
+    t = threading.Thread(target=over_budget, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    # The over-budget submit must be parked on the semaphore, not admitted.
+    assert not blocked_result and len(gates) == depth
+
+    # Completing the OLDEST round frees exactly one slot.
+    gates[0].release()
+    np.asarray(handles[0][0])
+    t.join(timeout=10.0)
+    assert not t.is_alive() and len(blocked_result) == 1
+    assert len(gates) == depth + 1
+
+    for g in gates[1:]:
+        g.release()
+    for h, n in handles[1:] + blocked_result:
+        assert np.asarray(h).shape == (8, 3)
+    assert xh.inflight_rounds == 0
+
+
+def test_depth1_is_lockstep(xh_pair, monkeypatch):
+    """Depth 1 reproduces lockstep dispatch exactly: a second submit is
+    not even BROADCAST until the first round materialized (safe fallback,
+    acceptance criterion)."""
+    xh, _ref, _v = xh_pair
+    monkeypatch.setattr(xh, "pipeline_depth", 1)
+    monkeypatch.setattr(xh, "_slots", threading.Semaphore(1))
+    order = []
+    real_send = xh._send_round
+
+    def logged_send(flag, aux, payload=b""):
+        order.append(("send", flag))
+        return real_send(flag, aux, payload)
+
+    monkeypatch.setattr(xh, "_send_round", logged_send)
+    images = np.zeros((4, 16, 16, 3), np.uint8)
+    h1, n1 = xh.predict_async(images)
+    t = threading.Thread(
+        target=lambda: order.append(("done2", xh.predict(images).shape)),
+        daemon=True,
+    )
+    t.start()
+    time.sleep(0.15)
+    assert len([e for e in order if e[0] == "send"]) == 1  # second not sent
+    np.asarray(h1)  # materialize round 1 -> slot frees -> round 2 proceeds
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    assert [e[0] for e in order] == ["send", "send", "done2"]
+
+
+def test_pipelined_matches_lockstep_single_process(xh_pair):
+    """Bit-identical logits: the same request sequence (bucket changes
+    included) served sync (lockstep) then pipelined at depth 4.
+
+    The pipelined arm drives the raw API the way a real caller must:
+    materialize the oldest round once ``depth`` are in flight (submitting
+    past the budget without materializing anything would just park on the
+    backpressure semaphore -- the contract test_inflight_budget_respected
+    proves -- since only the serving dispatcher has a completion thread)."""
+    from collections import deque
+
+    xh, _ref, _v = xh_pair
+    rng = np.random.default_rng(3)
+    batches = [
+        rng.integers(0, 256, (n, 16, 16, 3), np.uint8)
+        for n in (8, 3, 4, 7, 2, 8)
+    ]
+    lockstep = [xh.predict(b) for b in batches]
+    pipelined = []
+    pending = deque()
+    for b in batches:  # budget 4: up to 4 rounds overlap
+        pending.append(xh.predict_async(b))
+        while len(pending) >= xh.pipeline_depth:
+            h, n = pending.popleft()
+            pipelined.append(np.asarray(h)[:n])
+    while pending:
+        h, n = pending.popleft()
+        pipelined.append(np.asarray(h)[:n])
+    for a, b in zip(lockstep, pipelined):
+        assert np.array_equal(a, b), "pipelined logits diverge from lockstep"
+
+
+def test_predict_async_failure_releases_slot(xh_pair, monkeypatch):
+    """A broadcast/dispatch failure must not leak an in-flight slot (the
+    budget would shrink forever under transient errors)."""
+    xh, _ref, _v = xh_pair
+
+    def boom(batch, fast=False):
+        raise RuntimeError("injected dispatch failure")
+
+    monkeypatch.setattr(xh, "_dispatch_round", boom)
+    images = np.zeros((4, 16, 16, 3), np.uint8)
+    before = xh.inflight_rounds
+    with pytest.raises(RuntimeError, match="injected"):
+        xh.predict_async(images)
+    assert xh.inflight_rounds == before
+
+
+def test_round_stall_watch_arming_and_ewma():
+    """The leader/follower stall watch: unarmed while a (mode, bucket) has
+    no completed sample (compile round), EWMA-bounded after; on_stall is
+    injectable so the exit(70) path is assertable in-process."""
+    from kubernetes_deep_learning_tpu.parallel.crosshost import RoundStallWatch
+
+    fired = []
+    watch = RoundStallWatch(
+        floor_s=0.1, multiple=2.0, label="test", on_stall=fired.append
+    )
+    key = ("exact", 8)
+    # Compile round: in flight way past the floor with no sample -> silent.
+    watch.begin(0, key)
+    time.sleep(0.4)
+    assert not fired
+    watch.complete(0, 0.01)  # seeds the EWMA
+    # Steady-state round past max(floor, multiple x EWMA) -> stall fires.
+    watch.begin(1, key)
+    deadline = time.monotonic() + 5.0
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert fired and "stall bound" in fired[0]
+    watch.stop()
+
+
+def test_follower_stall_detection_exits_70():
+    """The follower-side watch's REAL stall action: a subprocess whose
+    steady-state round never completes must exit 70 (the gang-restart
+    contract), driven through the exact RoundStallWatch defaults the
+    follower loop uses."""
+    src = (
+        "import time\n"
+        "from kubernetes_deep_learning_tpu.parallel.crosshost import "
+        "RoundStallWatch\n"
+        "w = RoundStallWatch(floor_s=0.2, multiple=2.0, label='follower')\n"
+        "w.begin(0, ('exact', 8)); w.complete(0, 0.01)\n"
+        "w.begin(1, ('exact', 8))  # never completes: a wedged collective\n"
+        "time.sleep(30)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", src],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 70, (proc.returncode, proc.stdout, proc.stderr)
+    assert "exiting 70" in proc.stdout
+
+
+def test_dispatcher_uses_engine_depth_and_label(xh_pair):
+    """The serving wiring: ServedModel's InFlightDispatcher takes the
+    engine's preferred depth (the fleet budget, not KDLT_PIPELINE_DEPTH)
+    and labels the kdlt_pipeline_* series with engine="crosshost"."""
+    from kubernetes_deep_learning_tpu.runtime.engine import InFlightDispatcher
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    xh, _ref, _v = xh_pair
+
+    class _Artifact:
+        spec = xh.spec
+        path = "/models/xh-pipe-test/1"
+        variables = None
+        metadata = {}
+
+    from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostEngine
+
+    registry = metrics_lib.Registry()
+    engine = CrossHostEngine(_Artifact(), xh, registry=registry)
+    assert engine.preferred_pipeline_depth == xh.pipeline_depth
+    assert engine.pipeline_engine_label == "crosshost"
+
+    disp = InFlightDispatcher(
+        engine, depth=engine.preferred_pipeline_depth, registry=registry
+    )
+    try:
+        rng = np.random.default_rng(5)
+        images = rng.integers(0, 256, (4, 16, 16, 3), np.uint8)
+        futs = [disp.submit(images) for _ in range(3)]
+        outs = [f.result(timeout=60) for f in futs]
+        want = xh.predict(images)
+        for o in outs:
+            assert np.array_equal(o, want)
+    finally:
+        disp.close()
+    page = registry.render()
+    assert 'kdlt_pipeline_execute_seconds_count{engine="crosshost"}' in page
+    assert "kdlt_crosshost_rounds_total" in page
+    assert "kdlt_crosshost_pipeline_depth" in page
+
+
+_EQUIVALENCE_WORKER = r"""
+import os, sys, tempfile
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+from kubernetes_deep_learning_tpu.utils.platform import force_platform
+force_platform("cpu")
+from kubernetes_deep_learning_tpu.utils.distributed import initialize
+assert initialize()
+import jax
+import numpy as np
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostForward
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.export import artifact as art
+
+spec = register_spec(ModelSpec(
+    name="xh-equiv", family="vit-tiny", input_shape=(16, 16, 3),
+    labels=("a", "b", "c"), preprocessing="tf",
+))
+root = sys.argv[2]
+v1 = init_variables(spec, seed=9)
+v2 = init_variables(spec, seed=23)
+if jax.process_index() == 0:
+    art.save_artifact(art.version_dir(root, spec.name, 1), spec, v1, None, {})
+    art.save_artifact(art.version_dir(root, spec.name, 2), spec, v2, None, {})
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("artifacts-written")
+
+mesh = make_mesh(8, devices=jax.devices())
+xh = CrossHostForward(
+    spec, mesh, v1, buckets=(4, 8), model_root=root, model_name=spec.name,
+    pipeline_depth=2,
+)
+xh.version = 1
+
+if sys.argv[1] == "follower":
+    rounds = xh.follower_loop()
+    # 6 predict rounds per arm x 2 arms (RELOAD rounds are not predicts).
+    assert rounds == 12, f"expected 12 predict rounds, served {rounds}"
+    print("FOLLOWER-OK", flush=True)
+    sys.exit(0)
+
+rng = np.random.default_rng(0)
+# Bucket changes (4 and 8) plus partial batches, same sequence both arms.
+batches = [
+    rng.integers(0, 256, (n, *spec.input_shape), np.uint8)
+    for n in (8, 3, 4, 7, 2, 8)
+]
+
+def arm(pipelined):
+    # Rounds 1-3 on v1, mid-stream RELOAD to v2, rounds 4-6 on v2.
+    outs = []
+    def run(seq):
+        if pipelined:
+            # Sliding window at the budget: materialize the oldest once
+            # depth rounds are in flight (submitting past the budget
+            # without materializing would park on the backpressure
+            # semaphore forever -- there is no completion thread here).
+            from collections import deque
+            pending = deque()
+            for b in seq:
+                pending.append(xh.predict_async(b))  # depth-2 overlap
+                while len(pending) >= xh.pipeline_depth:
+                    h, n = pending.popleft()
+                    outs.append(np.asarray(h)[:n])
+            while pending:
+                h, n = pending.popleft()
+                outs.append(np.asarray(h)[:n])
+        else:
+            outs.extend(xh.predict(b) for b in seq)
+    run(batches[:3])
+    xh.reload(2)
+    run(batches[3:])
+    xh.reload(1)  # reset for the next arm
+    return outs
+
+lockstep = arm(pipelined=False)
+pipelined = arm(pipelined=True)
+for i, (a, b) in enumerate(zip(lockstep, pipelined)):
+    assert np.array_equal(a, b), f"round {i}: pipelined logits diverge"
+xh.shutdown()
+print("LEADER-OK", flush=True)
+"""
+
+
+def test_multiprocess_pipelined_bit_identical_to_lockstep():
+    """The tentpole's equivalence bar on a REAL 2-process fleet: the same
+    round sequence -- bucket changes and a mid-stream RELOAD included --
+    produces bit-identical logits lockstep vs pipelined (depth 2)."""
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="kdlt-xh-equiv-")
+    leader_out, follower_out = _run_fleet(_EQUIVALENCE_WORKER, extra_args=[root])
+    assert "LEADER-OK" in leader_out, leader_out[-2000:]
+    assert "FOLLOWER-OK" in follower_out, follower_out[-2000:]
+
+
+_FOLLOWER_STALL_WORKER = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+if sys.argv[1] == "follower":
+    # Tight stall bound so the wedged round is declared quickly; the
+    # leader keeps the default (it must NOT be the one exiting 70 here).
+    os.environ["KDLT_XH_STALL_FLOOR_S"] = "1.0"
+    os.environ["KDLT_XH_STALL_MULTIPLE"] = "2.0"
+from kubernetes_deep_learning_tpu.utils.platform import force_platform
+force_platform("cpu")
+from kubernetes_deep_learning_tpu.utils.distributed import initialize
+assert initialize()
+import jax
+import numpy as np
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+from kubernetes_deep_learning_tpu.parallel.crosshost import (
+    CrossHostForward, _PREDICT,
+)
+from kubernetes_deep_learning_tpu.models import init_variables
+
+spec = register_spec(ModelSpec(
+    name="xh-stall", family="vit-tiny", input_shape=(16, 16, 3),
+    labels=("a", "b", "c"), preprocessing="tf",
+))
+variables = init_variables(spec, seed=3)
+mesh = make_mesh(8, devices=jax.devices())
+xh = CrossHostForward(spec, mesh, variables, buckets=(8,), pipeline_depth=2)
+
+if sys.argv[1] == "follower":
+    xh.follower_loop()  # the stall watch must exit(70) from inside
+    print("FOLLOWER-UNEXPECTED-RETURN", flush=True)
+    os._exit(1)
+
+rng = np.random.default_rng(0)
+batch = rng.integers(0, 256, (8, *spec.input_shape), np.uint8)
+xh.predict(batch)  # warm round: compiles AND seeds the follower's EWMA
+# Now wedge the fleet mid-round: send the control+payload for a round the
+# leader never dispatches its own collective half of.  The follower
+# dispatches, its collective blocks on the absent leader, and ITS stall
+# watch -- not the leader's -- must end the process with exit 70.
+xh._send_round(_PREDICT, 8, batch.tobytes())
+time.sleep(12)
+os._exit(0)
+"""
+
+
+def test_follower_stall_exits_70_in_fleet():
+    """End to end on a real fleet: a round wedged by a vanished leader
+    half trips the FOLLOWER's own EWMA stall detection -> exit 70 (the
+    satellite's follower-side completion protocol)."""
+    leader, follower = _run_fleet_raw(_FOLLOWER_STALL_WORKER, timeout=240)
+    (l_rc, l_out), (f_rc, f_out) = leader, follower
+    assert f_rc == 70, f"follower rc {f_rc}:\n{f_out[-2000:]}"
+    assert "exiting 70" in f_out, f_out[-2000:]
+    assert l_rc == 0, f"leader rc {l_rc}:\n{l_out[-2000:]}"

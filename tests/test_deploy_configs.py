@@ -310,6 +310,52 @@ def test_deploy_wires_structured_logs_and_profile_dir():
         )
 
 
+def test_deploy_wires_crosshost_pipeline_envs():
+    """Cross-host dispatch pipelining (ISSUE 5): the model tier carries the
+    fleet-wide in-flight budget and follower stall-detection envs in both
+    deploy targets, with values the code would actually accept (every
+    process of a fleet must agree on the depth, so it must come from the
+    manifest, not per-pod defaults)."""
+    from kubernetes_deep_learning_tpu.parallel.crosshost import (
+        XH_PIPELINE_DEPTH_ENV,
+        XH_STALL_FLOOR_S_ENV,
+        XH_STALL_MULTIPLE_ENV,
+        resolve_xh_pipeline_depth,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    container = model_dep["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e.get("value", "") for e in container.get("env", [])}
+    for name in (
+        XH_PIPELINE_DEPTH_ENV, XH_STALL_FLOOR_S_ENV, XH_STALL_MULTIPLE_ENV
+    ):
+        assert name in env, f"model tier must set {name}"
+    depth = resolve_xh_pipeline_depth(int(env[XH_PIPELINE_DEPTH_ENV]))
+    assert depth == int(env[XH_PIPELINE_DEPTH_ENV]) >= 1
+    assert float(env[XH_STALL_FLOOR_S_ENV]) > 0, "stall detection wired off"
+    assert float(env[XH_STALL_MULTIPLE_ENV]) >= 1.0
+
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    services = compose["services"]
+    replicas = [
+        name for name, svc in services.items()
+        if isinstance(svc.get("build"), dict)
+        and "model-server" in svc["build"].get("dockerfile", "")
+    ]
+    assert len(replicas) >= 2
+    depths = set()
+    for name in replicas:
+        env = services[name].get("environment", {})
+        for var in (
+            XH_PIPELINE_DEPTH_ENV, XH_STALL_FLOOR_S_ENV, XH_STALL_MULTIPLE_ENV
+        ):
+            assert var in env, f"compose service {name!r} missing {var}"
+        depths.add(str(env[XH_PIPELINE_DEPTH_ENV]))
+    # The budget is a fleet-wide protocol parameter: replicas must agree.
+    assert len(depths) == 1, f"replicas disagree on the depth: {depths}"
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():
