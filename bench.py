@@ -1605,6 +1605,177 @@ def bench_overload_ab(duration_s=8.0, device_ms=100.0, deadline_ms=600.0,
     return out, 0 if ok else 1
 
 
+def bench_multimodel_ab(duration_s=6.0, heavy_device_ms=120.0,
+                        light_device_ms=5.0, heavy_deadline_ms=2000.0,
+                        light_deadline_ms=300.0, rate_x=2.0, light_rps=40.0,
+                        buckets=(1, 2, 4)):
+    """Multi-model scheduling A/B: weighted deadline-aware vs naive FIFO.
+
+    Two stub-backed models share ONE UnifiedScheduler + one in-flight
+    dispatcher (the multi-model serving core, runtime/scheduler.py): a
+    HEAVY model (``heavy_device_ms`` per batch, generous deadline) offered
+    at ``rate_x`` times its known capacity, and a LIGHT model (cheap
+    batches, tight deadline) offered at a rate costing only a few percent
+    of device time.  This is the INFaaS/Clipper mixed-tenancy scenario:
+    under overload the heavy model's backlog grows without bound, and a
+    naive arrival-order (FIFO) arbiter starves the light model behind it
+    -- every light request waits out the ever-older heavy queue head and
+    blows its tight deadline, even though serving it would cost almost
+    nothing.  The weighted deadline-aware policy fixes exactly this: the
+    light lane's earlier effective deadlines and its weight-floor share
+    guarantee let it preempt the doomed heavy backlog.
+
+    Open-loop semantics (as in --overload-ab): latency is measured from
+    each request's SCHEDULED send time.  Per model, goodput is in-deadline
+    completions as a FRACTION of offered load; the headline is the
+    worst-model goodput -- the number a platform operator must defend per
+    tenant.  rc=0 iff the weighted arm beats FIFO on worst-model goodput
+    by >= 1.2x AND does not lose on the heavy model (the light model's
+    rescue must come out of the doomed backlog, not the heavy model's
+    viable completions).
+    """
+    import threading
+
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.scheduler import UnifiedScheduler
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving.admission import Deadline
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    class _Artifact:
+        def __init__(self, spec):
+            self.spec = spec
+
+    buckets = tuple(sorted(buckets))
+    shape = (32, 32, 3)
+    heavy = register_spec(ModelSpec(
+        name="mm-heavy", family="xception", input_shape=shape,
+        labels=("a", "b", "c"),
+    ))
+    light = register_spec(ModelSpec(
+        name="mm-light", family="xception", input_shape=shape,
+        labels=("x", "y"),
+    ))
+    heavy_capacity = buckets[-1] / (heavy_device_ms / 1e3)
+    heavy_rps = rate_x * heavy_capacity
+    plans = {
+        heavy.name: (heavy_rps, heavy_deadline_ms, heavy_device_ms),
+        light.name: (light_rps, light_deadline_ms, light_device_ms),
+    }
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=shape, dtype=np.uint8)
+    log(
+        f"multimodel A/B: heavy capacity {heavy_capacity:.0f} img/s "
+        f"({buckets[-1]}-bucket / {heavy_device_ms}ms), offered "
+        f"{heavy_rps:.0f} rps @ {heavy_deadline_ms:.0f}ms deadline; light "
+        f"{light_rps:.0f} rps @ {light_deadline_ms:.0f}ms deadline "
+        f"({light_device_ms}ms/batch); {duration_s}s per arm"
+    )
+
+    def run_arm(policy: str) -> dict:
+        engines = {
+            name: StubEngine(
+                _Artifact(spec), buckets=buckets, async_device=True,
+                device_ms_per_batch=plans[name][2],
+            )
+            for name, spec in ((heavy.name, heavy), (light.name, light))
+        }
+        sched = UnifiedScheduler(
+            registry=metrics_lib.Registry(), policy=policy, weights={},
+        )
+        for name, engine in engines.items():
+            sched.register(name, engine, max_delay_ms=2.0)
+        results: dict[str, list] = {name: [] for name in plans}
+        results_lock = threading.Lock()
+        threads = []
+        t_base = time.monotonic() + 0.25
+
+        def fire(name: str, at: float, deadline_s: float) -> None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                fut = sched.submit(name, img, deadline=Deadline(deadline_s))
+                fut.result(timeout=deadline_s * 4 + 2.0)
+                ok = True
+            except Exception:
+                ok = False
+            lat = time.monotonic() - at  # open-loop: from the SCHEDULED send
+            with results_lock:
+                results[name].append((lat, ok))
+
+        for name, (rps, deadline_ms, _dev) in plans.items():
+            n = int(duration_s * rps)
+            for i in range(n):
+                threads.append(threading.Thread(
+                    target=fire,
+                    args=(name, t_base + i / rps, deadline_ms / 1e3),
+                    daemon=True,
+                ))
+        for t in threads:
+            t.start()
+        end_by = t_base + duration_s + max(
+            2.0, 4 * heavy_deadline_ms / 1e3
+        )
+        for t in threads:
+            t.join(timeout=max(0.0, end_by - time.monotonic()))
+        sched.close(drain=False)
+        for e in engines.values():
+            e.close()
+        arm: dict = {"policy": policy, "models": {}}
+        worst = None
+        for name, (rps, deadline_ms, _dev) in plans.items():
+            offered = int(duration_s * rps)
+            done = results[name]
+            in_deadline = sum(
+                1 for lat, ok in done if ok and lat <= deadline_ms / 1e3
+            )
+            frac = in_deadline / max(offered, 1)
+            arm["models"][name] = {
+                "offered": offered,
+                "completed": sum(1 for _, ok in done if ok),
+                "in_deadline": in_deadline,
+                "goodput_frac": round(frac, 3),
+                "goodput_rps": round(in_deadline / duration_s, 2),
+            }
+            worst = frac if worst is None else min(worst, frac)
+        arm["worst_model_goodput_frac"] = round(worst or 0.0, 3)
+        log(
+            f"  policy={policy:17s}: worst-model goodput "
+            f"{arm['worst_model_goodput_frac']:.3f} "
+            + " ".join(
+                f"{n}={m['goodput_frac']:.3f}" for n, m in arm["models"].items()
+            )
+        )
+        return arm
+
+    arm_weighted = run_arm("weighted_deadline")
+    arm_fifo = run_arm("fifo")
+    w_worst = arm_weighted["worst_model_goodput_frac"]
+    f_worst = arm_fifo["worst_model_goodput_frac"]
+    w_heavy = arm_weighted["models"][heavy.name]["goodput_frac"]
+    f_heavy = arm_fifo["models"][heavy.name]["goodput_frac"]
+    ratio = w_worst / max(f_worst, 1e-9)
+    # The light model's rescue must not come out of the heavy model's
+    # viable completions: heavy goodput may dip only within noise (the
+    # light lane costs a few percent of device time by construction).
+    ok = ratio >= 1.2 and w_heavy >= 0.8 * f_heavy
+    out = {
+        "metric": (
+            f"multi-model scheduling A/B (2 stub models, one shared "
+            f"dispatcher; heavy {rate_x:g}x overloaded @ "
+            f"{heavy_deadline_ms:.0f}ms, light {light_rps:g} rps @ "
+            f"{light_deadline_ms:.0f}ms): worst-model in-deadline goodput, "
+            f"weighted_deadline vs fifo"
+        ),
+        "value": round(ratio, 2),
+        "unit": "x worst-model in-deadline goodput (weighted / fifo)",
+        "vs_baseline": round(ratio, 2),
+        "arms": {"weighted_deadline": arm_weighted, "fifo": arm_fifo},
+    }
+    return out, 0 if ok else 1
+
+
 def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
                    rate_rps=24.0, hedge_delay_ms=150.0, probe_interval_s=0.5,
                    kill_at_frac=0.4, seed=0):
@@ -2365,6 +2536,40 @@ def main() -> int:
         help="comma-separated in-flight round budgets for --crosshost-ab",
     )
     p.add_argument(
+        "--multimodel-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: multi-model scheduling A/B -- two stub "
+             "models share one UnifiedScheduler + dispatcher; a heavy model "
+             "overloaded at --mm-rate-x with a generous deadline, a light "
+             "model with a tight deadline; weighted_deadline vs fifo "
+             "arbitration for this many seconds per arm (no device needed; "
+             "rc=0 iff the weighted arm wins worst-model in-deadline "
+             "goodput by >= 1.2x without degrading the heavy model)",
+    )
+    p.add_argument(
+        "--mm-heavy-device-ms", type=float, default=120.0,
+        help="simulated device ms per heavy-model batch for --multimodel-ab",
+    )
+    p.add_argument(
+        "--mm-light-device-ms", type=float, default=5.0,
+        help="simulated device ms per light-model batch for --multimodel-ab",
+    )
+    p.add_argument(
+        "--mm-heavy-deadline-ms", type=float, default=2000.0,
+        help="heavy-model per-request deadline for --multimodel-ab",
+    )
+    p.add_argument(
+        "--mm-light-deadline-ms", type=float, default=300.0,
+        help="light-model per-request deadline for --multimodel-ab",
+    )
+    p.add_argument(
+        "--mm-rate-x", type=float, default=2.0,
+        help="heavy-model offered load as a multiple of its capacity",
+    )
+    p.add_argument(
+        "--mm-light-rps", type=float, default=40.0,
+        help="light-model offered request rate for --multimodel-ab",
+    )
+    p.add_argument(
         "--chaos-ab", type=float, default=0, metavar="SECONDS",
         help="INSTEAD of the sweep: serving-path fault-tolerance A/B -- "
              "front two stub model-tier replicas with the real gateway, "
@@ -2461,7 +2666,7 @@ def main() -> int:
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
                      "batcher_sweep", "host_saturation", "overload_ab",
-                     "chaos_ab", "trace_breakdown"):
+                     "chaos_ab", "trace_breakdown", "multimodel_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -2494,6 +2699,15 @@ def main() -> int:
             "trace": {
                 "requests": args.trace_breakdown,
                 "device_ms": args.trace_device_ms,
+            },
+            "multimodel": {
+                "duration_s": args.multimodel_ab,
+                "heavy_device_ms": args.mm_heavy_device_ms,
+                "light_device_ms": args.mm_light_device_ms,
+                "heavy_deadline_ms": args.mm_heavy_deadline_ms,
+                "light_deadline_ms": args.mm_light_deadline_ms,
+                "rate_x": args.mm_rate_x,
+                "light_rps": args.mm_light_rps,
             },
             "crosshost": {
                 "rounds": args.crosshost_ab,
@@ -2568,6 +2782,19 @@ def main() -> int:
             rate_x=args.overload_rate_x,
             buckets=tuple(int(b) for b in args.overload_buckets.split(",")),
             max_delay_ms=args.max_delay_ms,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.multimodel_ab > 0:
+        out, rc = bench_multimodel_ab(
+            duration_s=args.multimodel_ab,
+            heavy_device_ms=args.mm_heavy_device_ms,
+            light_device_ms=args.mm_light_device_ms,
+            heavy_deadline_ms=args.mm_heavy_deadline_ms,
+            light_deadline_ms=args.mm_light_deadline_ms,
+            rate_x=args.mm_rate_x,
+            light_rps=args.mm_light_rps,
         )
         print(json.dumps(out), flush=True)
         return rc

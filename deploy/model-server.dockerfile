@@ -7,6 +7,12 @@
 #   docker build -t kdlt-model-server -f deploy/model-server.dockerfile .
 # The artifact is produced beforehand with:
 #   kdlt-export --model clothing-model --weights xception_v4.h5 --output ./models
+# MULTI-MODEL: export any further models into the same root before the build
+# (e.g. `kdlt-export --model vit --output ./models`); the server's registry
+# scans /models and serves every <name>/<version>/ it finds from one process,
+# with the unified scheduler (KDLT_SCHED_POLICY/KDLT_SCHED_WEIGHTS, GUIDE 10h)
+# arbitrating their shared device time.  Route via /predict/<model> at the
+# gateway or /v1/models/<name>:predict here.
 #
 # GPU-vs-CPU in the reference is a one-line image swap (tf-serving.dockerfile:1);
 # here TPU-vs-CPU is one pip extra: jax[tpu] resolves the TPU PJRT plugin on a

@@ -6,6 +6,11 @@ from kubernetes_deep_learning_tpu.runtime.engine import (
     resolve_pipeline_depth,
 )
 from kubernetes_deep_learning_tpu.runtime.batcher import BatcherClosed, DynamicBatcher, QueueFull
+from kubernetes_deep_learning_tpu.runtime.scheduler import (
+    UnifiedScheduler,
+    resolve_policy,
+    resolve_weights,
+)
 
 
 def create_batcher(engine, impl: str = "auto", dispatcher=None, **kwargs):
@@ -61,6 +66,9 @@ __all__ = [
     "InferenceEngine",
     "InFlightDispatcher",
     "QueueFull",
+    "UnifiedScheduler",
     "create_batcher",
     "resolve_pipeline_depth",
+    "resolve_policy",
+    "resolve_weights",
 ]

@@ -134,11 +134,16 @@ class InFlightDispatcher:
     (utils.metrics.PIPELINE_STAGES documents the stage semantics).
     """
 
-    def __init__(self, engine, depth: int | None = None,
+    def __init__(self, engine=None, depth: int | None = None,
                  registry: metrics_lib.Registry | None = None,
                  watchdog: bool | None = None,
                  stall_multiple: float | None = None,
                  stall_floor_s: float | None = None):
+        # ``engine=None`` is the multi-engine (scheduler) mode: the unified
+        # scheduler owns ONE dispatcher for the whole model tier and passes
+        # each batch's engine per submit() -- one bounded in-flight budget
+        # (the device runs one program at a time no matter which model
+        # compiled it), one FIFO completion thread, one watchdog.
         self._engine = engine
         self.depth = resolve_pipeline_depth(depth)
         self._slots = threading.Semaphore(self.depth)
@@ -148,22 +153,23 @@ class InFlightDispatcher:
         self._closed = False
         self._close_lock = threading.Lock()
         registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
+        self._registry = registry
         # Engines that are themselves a pipeline front (the cross-host
         # round protocol) label their stage series so dashboards separate
         # per-chip dispatch from fleet rounds; plain engines keep the
-        # unlabeled single-host series.
+        # unlabeled single-host series.  Per-model stage series (scheduler
+        # mode) are minted lazily in _stages_for.
         self._m_stage = metrics_lib.pipeline_stage_histograms(
             registry, engine=getattr(engine, "pipeline_engine_label", None)
         )
+        self._m_stage_models: dict[str, dict] = {}
         # Trace-aware engines (CrossHostEngine) take the member requests'
         # RequestTrace carriers through predict_async and record their own
         # protocol spans (crosshost.*) under the same waterfall the
-        # pipeline-stage spans land in.
-        import inspect as _inspect
-
-        self._async_takes_traces = "traces" in _inspect.signature(
-            engine.predict_async
-        ).parameters if hasattr(engine, "predict_async") else False
+        # pipeline-stage spans land in.  Cached per engine TYPE: the
+        # signature is a class property, and the scheduler swaps engine
+        # instances across hot reloads.
+        self._takes_traces_cache: dict[type, bool] = {}
         self._m_depth = registry.gauge(
             "kdlt_pipeline_depth", "configured in-flight dispatch depth"
         )
@@ -174,14 +180,14 @@ class InFlightDispatcher:
         from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 
         self._faults = faults_lib.from_env()
-        # Watchdog state: in-flight ledger (token -> (future, batch rows,
-        # dispatch time)) the watchdog scans, per-bucket EWMA of observed
-        # dispatch->sync latency, and the terminal "stalled" flag.
+        # Watchdog state: in-flight ledger (token -> (future, (engine,
+        # bucket) key, dispatch time)) the watchdog scans, per-key EWMA of
+        # observed dispatch->sync latency, and the terminal "stalled" flag.
         self._stalled = threading.Event()
-        self._inflight: dict[int, tuple[Future, int, float]] = {}
+        self._inflight: dict[int, tuple[Future, tuple, float]] = {}
         self._inflight_lock = threading.Lock()
         self._seq = 0
-        self._expected_s: dict[int, float] = {}
+        self._expected_s: dict[tuple, float] = {}
         if watchdog is None:
             watchdog = os.environ.get(WATCHDOG_ENV, "").strip() != "0"
         self._stall_multiple = (
@@ -211,7 +217,39 @@ class InFlightDispatcher:
         dispatcher no longer accepts work and serving health should fail."""
         return self._stalled.is_set()
 
-    def submit(self, images: np.ndarray, traces=()) -> Future:
+    def _takes_traces(self, engine) -> bool:
+        key = type(engine)
+        got = self._takes_traces_cache.get(key)
+        if got is None:
+            import inspect as _inspect
+
+            got = "traces" in _inspect.signature(
+                engine.predict_async
+            ).parameters if hasattr(engine, "predict_async") else False
+            self._takes_traces_cache[key] = got
+        return got
+
+    def _stages_for(self, model: str | None) -> dict:
+        """The stage histograms a batch's times land in: the unlabeled
+        (or engine-labeled) default, or the model-labeled set when the
+        scheduler attributes device time per model.  Lazily minted, memoized
+        (the central helper's registry dedupe makes re-minting an error)."""
+        if model is None:
+            return self._m_stage
+        stages = self._m_stage_models.get(model)
+        if stages is None:
+            stages = metrics_lib.pipeline_stage_histograms(
+                self._registry, model=model
+            )
+            self._m_stage_models[model] = stages
+        return stages
+
+    def _engine_key(self, engine):
+        spec = getattr(engine, "spec", None)
+        return getattr(spec, "name", None) or id(engine)
+
+    def submit(self, images: np.ndarray, traces=(), engine=None,
+               model: str | None = None) -> Future:
         """Dispatch one uint8 batch; returns a Future of its logits rows.
 
         Blocks only while ``depth`` batches are in flight (backpressure) --
@@ -223,7 +261,16 @@ class InFlightDispatcher:
         spans -- the exact boundaries that feed kdlt_pipeline_*_seconds --
         recorded at completion, so a slow request shows WHICH stage of its
         batch ate the time, not just that the batch was slow.
+
+        ``engine`` overrides the construction-time engine for THIS batch
+        (the unified scheduler's multi-model mode: many engines, one
+        in-flight budget); ``model`` attributes the batch's stage times to
+        the model-labeled kdlt_pipeline_* series.
         """
+        engine = engine if engine is not None else self._engine
+        if engine is None:
+            raise ValueError("no engine: pass engine= per submit or at init")
+        stages = self._stages_for(model)
         if self._stalled.is_set():
             # The completion thread is wedged on a sync that never returns;
             # slots will never free, so blocking on one would hang the
@@ -239,30 +286,32 @@ class InFlightDispatcher:
         if self._stalled.is_set():
             self._slots.release()
             raise DispatchStall("dispatch pipeline is stalled")
-        self._m_stage["enqueue_wait"].observe(time.perf_counter() - t0)
+        stages["enqueue_wait"].observe(time.perf_counter() - t0)
         w1 = trace_lib.now_s() if traces else 0.0
         fut: Future = Future()
         t1 = time.perf_counter()
         try:
             if self._faults is not None:
                 self._faults.fire("dispatch.submit")
-            if self._async_takes_traces:
-                handle, n = self._engine.predict_async(images, traces=traces)
+            if self._takes_traces(engine):
+                handle, n = engine.predict_async(images, traces=traces)
             else:
-                handle, n = self._engine.predict_async(images)
+                handle, n = engine.predict_async(images)
         except Exception as e:  # dispatch failure belongs to THIS future
             self._slots.release()
             fut.set_exception(e)
             return fut
-        self._m_stage["dispatch"].observe(time.perf_counter() - t1)
+        stages["dispatch"].observe(time.perf_counter() - t1)
         dispatched_at = time.perf_counter()
         w2 = trace_lib.now_s() if traces else 0.0
+        bkey = (self._engine_key(engine), self._bucket_of(engine, n))
         with self._inflight_lock:
             token = self._seq
             self._seq += 1
-            self._inflight[token] = (fut, n, dispatched_at)
+            self._inflight[token] = (fut, bkey, dispatched_at)
         self._completions.put(
-            (handle, n, fut, dispatched_at, token, traces, (w0, w1, w2))
+            (handle, n, fut, dispatched_at, token, traces, (w0, w1, w2),
+             engine, stages, bkey)
         )
         return fut
 
@@ -275,11 +324,13 @@ class InFlightDispatcher:
 
     def _complete_one(
         self, handle, n: int, fut: Future, dispatched_at: float, token: int,
-        traces=(), walls=(0.0, 0.0, 0.0),
+        traces=(), walls=(0.0, 0.0, 0.0), engine=None, stages=None, bkey=None,
     ) -> None:
         """MUST NOT raise: an exception escaping here kills the completion
         thread, which strands every later batch's waiters AND deadlocks
         close() -- so anything unexpected fails THIS future instead."""
+        engine = engine if engine is not None else self._engine
+        stages = stages if stages is not None else self._m_stage
         w3 = trace_lib.now_s() if traces else 0.0
         t0 = time.perf_counter()
         try:
@@ -294,17 +345,17 @@ class InFlightDispatcher:
                 fut.set_exception(e)
             return
         t1 = time.perf_counter()
-        self._m_stage["execute"].observe(t0 - dispatched_at)
-        self._m_stage["readback"].observe(t1 - t0)
-        self._observe_latency(n, t1 - dispatched_at)
+        stages["execute"].observe(t0 - dispatched_at)
+        stages["readback"].observe(t1 - t0)
+        self._observe_latency(bkey, t1 - dispatched_at)
         with self._inflight_lock:
             self._inflight.pop(token, None)
         try:
-            if hasattr(self._engine, "record_completed"):
+            if hasattr(engine, "record_completed"):
                 # The engine accounts only its own synchronous path;
                 # pipelined batches report here after materialization
                 # succeeds (failed batches never inflate the counters).
-                self._engine.record_completed(n, t1 - dispatched_at)
+                engine.record_completed(n, t1 - dispatched_at)
         except Exception:  # noqa: BLE001 - accounting must not stall results
             pass
         if traces:
@@ -332,8 +383,8 @@ class InFlightDispatcher:
 
     # --- watchdog ----------------------------------------------------------
 
-    def _bucket_of(self, n: int) -> int:
-        bucket_for = getattr(self._engine, "bucket_for", None)
+    def _bucket_of(self, engine, n: int) -> int:
+        bucket_for = getattr(engine, "bucket_for", None)
         if bucket_for is None:
             return n
         try:
@@ -341,22 +392,22 @@ class InFlightDispatcher:
         except Exception:  # noqa: BLE001 - accounting key only
             return n
 
-    def _observe_latency(self, n: int, seconds: float) -> None:
-        """Per-bucket EWMA of dispatch->sync latency; the watchdog's notion
-        of "expected"."""
-        b = self._bucket_of(n)
+    def _observe_latency(self, bkey, seconds: float) -> None:
+        """Per-(engine, bucket) EWMA of dispatch->sync latency; the
+        watchdog's notion of "expected".  Keyed per engine so a heavy
+        model's 100 ms buckets never inflate a light model's stall bound."""
         with self._inflight_lock:
-            prev = self._expected_s.get(b)
-            self._expected_s[b] = (
+            prev = self._expected_s.get(bkey)
+            self._expected_s[bkey] = (
                 seconds if prev is None else 0.7 * prev + 0.3 * seconds
             )
 
-    def _stall_bound_s(self, n: int) -> float:
-        """How long an in-flight dispatch of ``n`` rows may run before it
-        is stuck: multiple x the bucket's EWMA, never below the floor (and
-        exactly the floor until the bucket has a sample)."""
+    def _stall_bound_s(self, bkey) -> float:
+        """How long an in-flight dispatch with this (engine, bucket) key may
+        run before it is stuck: multiple x the key's EWMA, never below the
+        floor (and exactly the floor until the key has a sample)."""
         with self._inflight_lock:
-            expected = self._expected_s.get(self._bucket_of(n))
+            expected = self._expected_s.get(bkey)
         if expected is None:
             return self._stall_floor_s
         return max(self._stall_floor_s, self._stall_multiple * expected)
@@ -373,9 +424,9 @@ class InFlightDispatcher:
         with self._inflight_lock:
             entries = list(self._inflight.items())
         overdue = [
-            (token, fut, n)
-            for token, (fut, n, t0) in entries
-            if now - t0 > self._stall_bound_s(n)
+            (token, fut, bkey)
+            for token, (fut, bkey, t0) in entries
+            if now - t0 > self._stall_bound_s(bkey)
         ]
         if not overdue:
             return False

@@ -112,7 +112,12 @@ class AdmissionController:
             limiter if limiter is not None
             else (AdaptiveLimiter() if self.enabled else None)
         )
-        self._m = metrics_lib.admission_metrics(registry.with_labels(tier=tier))
+        self._tier_registry = registry.with_labels(tier=tier)
+        self._m = metrics_lib.admission_metrics(self._tier_registry)
+        # Per-model kdlt_admission_* slices (bounded `model` label, minted
+        # centrally): lazily created per model name the handlers pass in.
+        self._model_m: dict[str, dict] = {}
+        self._model_m_lock = threading.Lock()
         if self._limiter is not None:
             self._m["limit"].set(self._limiter.limit)
         self._lock = threading.Lock()
@@ -132,9 +137,40 @@ class AdmissionController:
     def limit(self) -> float | None:
         return self._limiter.limit if self._limiter is not None else None
 
-    def admit(self, deadline: Deadline | None = None) -> Ticket:
-        """Admit or raise Shed.  Order: drain, deadline, concurrency."""
+    def _model_metrics(self, model: str | None) -> dict | None:
+        if model is None:
+            return None
+        with self._model_m_lock:
+            mm = self._model_m.get(model)
+            if mm is None:
+                if len(self._model_m) >= 2 * metrics_lib.MODEL_LABEL_CAP:
+                    # Memo cap: past it, unmemoized names go straight to
+                    # the overflow bucket so a hostile stream of distinct
+                    # names cannot grow this dict (the label itself is
+                    # already capped by the central mint).
+                    return metrics_lib.admission_model_metrics(
+                        self._tier_registry, metrics_lib.MODEL_LABEL_OVERFLOW
+                    )
+                mm = metrics_lib.admission_model_metrics(
+                    self._tier_registry, model
+                )
+                self._model_m[model] = mm
+            return mm
+
+    def admit(
+        self, deadline: Deadline | None = None, model: str | None = None
+    ) -> Ticket:
+        """Admit or raise Shed.  Order: drain, deadline, concurrency.
+
+        ``model`` attributes the decision to the per-model
+        kdlt_admission_* slice (the bounded ``model`` label); callers pass
+        it once routing has resolved a REGISTERED model name, which is
+        what keeps the label's value set bounded by the model registry.
+        """
+        mm = self._model_metrics(model)
         self._m["requests"].inc()
+        if mm is not None:
+            mm["requests"].inc()
         if self._draining:
             self._shed(Shed(
                 "draining", 503, retry_after_s=DRAIN_RETRY_AFTER_S,
@@ -160,6 +196,8 @@ class AdmissionController:
         if deadline is not None:
             self._m["deadline_remaining_ms"].observe(max(deadline.remaining_ms(), 0.0))
         self._m["admitted"].inc()
+        if mm is not None:
+            mm["admitted"].inc()
         with self._lock:
             self._inflight += 1
             self._m["inflight"].set(float(self._inflight))

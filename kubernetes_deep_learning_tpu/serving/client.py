@@ -34,6 +34,7 @@ def predict_url(
     retries: int = 2,
     deadline_ms: float | None = None,
     stats: dict | None = None,
+    model: str | None = None,
 ) -> dict:
     """POST {"url": ...} to the gateway's /predict (reference test.py:15).
 
@@ -53,6 +54,13 @@ def predict_url(
     ``retried_shed`` (503 + Retry-After) vs ``retried_connect`` (connect/
     reset) -- the CLI prints them separately so an operator can tell
     overload from instability at a glance.
+
+    ``model`` routes to a non-default served model: the request goes to
+    ``/predict/<model>`` AND carries the X-Kdlt-Model header (path wins at
+    the gateway; the header survives path-rewriting proxies).  None keeps
+    the exact default-model wire shape -- bare ``/predict``, no model
+    header -- so deadline-unaware single-model deployments see zero
+    change.
     """
     import requests
 
@@ -65,11 +73,15 @@ def predict_url(
         from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
 
         headers[DEADLINE_HEADER] = f"{float(deadline_ms):.1f}"
+    path = "/predict"
+    if model is not None:
+        path = f"/predict/{model}"
+        headers[protocol.MODEL_HEADER] = model
     t0 = time.monotonic()
     for attempt in range(retries + 1):
         try:
             r = requests.post(
-                f"{gateway_url}/predict",
+                f"{gateway_url}{path}",
                 json={"url": image_url},
                 headers=headers,
                 timeout=timeout,
@@ -150,6 +162,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--gateway", default="http://localhost:9696")
     p.add_argument("--image-url", default=DEFAULT_IMAGE_URL)
     p.add_argument(
+        "--model", default=None,
+        help="route to this served model (/predict/<model> + X-Kdlt-Model "
+        "header); default: the gateway's default model, bare /predict",
+    )
+    p.add_argument(
         "--deadline-ms", type=float, default=None,
         help="end-to-end deadline budget propagated via X-Request-Deadline-Ms",
     )
@@ -168,6 +185,7 @@ def main(argv: list[str] | None = None) -> int:
     scores = predict_url(
         args.gateway, args.image_url,
         retries=args.retries, deadline_ms=args.deadline_ms, stats=stats,
+        model=args.model,
     )
     print(json.dumps(scores, indent=2))
     if args.trace:

@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -65,6 +66,15 @@ DEFAULT_SERVING_HOST = "localhost:8500"  # reference model_server.py:13
 SERVING_HOST_ENV = "KDLT_SERVING_HOST"
 MODEL_ENV = "KDLT_MODEL"
 DEFAULT_MODEL = "clothing-model"
+# Multi-model routing: ``POST /predict`` keeps the reference's shape and
+# serves the DEFAULT model ($KDLT_MODEL); ``POST /predict/<model>`` or the
+# X-Kdlt-Model header route to any other model the tier's registry serves.
+# Path wins over header (the more explicit signal).
+MODEL_HEADER = protocol.MODEL_HEADER
+WSGI_MODEL_KEY = "HTTP_X_KDLT_MODEL"
+# Model names are path/label material: constrain them before they touch
+# URLs, metrics labels, or upstream requests.
+_MODEL_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
 PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
 PER_IMAGE_TIMEOUT_S = 0.25   # extra upstream budget per batched image: a
                              # 256-image predict is one POST and must not be
@@ -120,17 +130,15 @@ class Gateway:
         # one upstream predict of up to this size (serving.microbatch) --
         # the model tier then sees few, fat requests.  0 = one upstream call
         # per request (the reference's shape, model_server.py:55).
+        # Coalescing is PER MODEL (a batch must be one model's images);
+        # non-default models get their batcher lazily on first request.
+        self._upstream_batch = upstream_batch
+        self._upstream_delay_ms = upstream_delay_ms
+        self._microbatchers: dict[str, object] = {}
+        self._microbatcher_lock = threading.Lock()
         self._microbatcher = None
         if upstream_batch > 0:
-            from kubernetes_deep_learning_tpu.serving.microbatch import (
-                UpstreamMicroBatcher,
-            )
-
-            self._microbatcher = UpstreamMicroBatcher(
-                self._predict_batch,
-                max_batch=upstream_batch,
-                max_delay_ms=upstream_delay_ms,
-            )
+            self._microbatcher = self._make_microbatcher(None)
         # bind=False skips the in-tree HTTP server entirely: serving.wsgi
         # wraps this object under an external WSGI server (gunicorn) instead,
         # the reference's production-server arrangement.
@@ -191,6 +199,54 @@ class Gateway:
             self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
+    # --- model routing -----------------------------------------------------
+
+    def _make_microbatcher(self, model: str | None):
+        from kubernetes_deep_learning_tpu.serving.microbatch import (
+            UpstreamMicroBatcher,
+        )
+
+        return UpstreamMicroBatcher(
+            lambda images, request_id, _m=model: self._predict_batch(
+                images, request_id, model=_m
+            ),
+            max_batch=self._upstream_batch,
+            max_delay_ms=self._upstream_delay_ms,
+        )
+
+    def _microbatcher_for(self, model: str | None):
+        """The per-model upstream micro-batcher (None when coalescing is
+        off).  One per model: a flush must be one model's images."""
+        if self._upstream_batch <= 0:
+            return None
+        if model is None or model == self.model:
+            return self._microbatcher
+        with self._microbatcher_lock:
+            mb = self._microbatchers.get(model)
+            if mb is None:
+                mb = self._make_microbatcher(model)
+                self._microbatchers[model] = mb
+            return mb
+
+    def resolve_model(self, path: str, header: str | None) -> str | None:
+        """Route a /predict request to a model name.
+
+        ``/predict`` -> the default model (reference-compatible);
+        ``/predict/<model>`` -> that model; the X-Kdlt-Model header applies
+        when the path carries no model.  Returns None for a malformed name
+        (the transports answer 404 without touching the upstream).
+        """
+        model: str | None = None
+        if path.startswith("/predict/"):
+            model = path[len("/predict/"):]
+        elif header:
+            model = str(header).strip()
+        if model is None or model == self.model:
+            return self.model
+        if not _MODEL_NAME_RE.match(model):
+            return None
+        return model
+
     # --- model-server client ----------------------------------------------
 
     def _session(self):
@@ -220,47 +276,81 @@ class Gateway:
     def breaker(self, value) -> None:
         self.pool.replicas[0].breaker = value
 
-    def _fetch_spec(self, replica) -> ModelSpec:
+    def _fetch_spec(self, replica, model: str | None = None) -> ModelSpec:
         """GET one replica's /v1/models/<name> contract (RequestException
         propagates -- the caller decides whether that means failover)."""
         r = self._session().get(
-            f"{replica.base}/v1/models/{self.model}", timeout=10
+            f"{replica.base}/v1/models/{model or self.model}", timeout=10
         )
+        if r.status_code == 404:
+            raise UpstreamError(
+                f"model tier serves no model {model or self.model!r}", 404
+            )
         r.raise_for_status()
         return ModelSpec.from_json(r.text)
 
     @property
     def spec(self) -> ModelSpec:
-        """The served model's contract, discovered from the model tier.
+        """The DEFAULT model's contract, discovered from the model tier.
 
         Discovery sweeps the replica pool (healthy replicas first) and the
         first answer becomes the pool's ``reference_spec`` -- the contract
         every other replica is validated against before serving traffic
         (see _validate_replica_spec).
         """
-        if self.pool.reference_spec is not None:
-            return self.pool.reference_spec
+        return self.spec_for(None)
+
+    def spec_for(self, model: str | None) -> ModelSpec:
+        """A model's reference contract, discovered on first use.
+
+        The default model keeps the original pool.reference_spec slot
+        (back-compat for everything built on the single-model surface);
+        other models land in pool.reference_specs keyed by name.
+        """
+        pool = self.pool
+        default = model is None or model == self.model
+        cached = (
+            pool.reference_spec if default else pool.reference_specs.get(model)
+        )
+        if cached is not None:
+            return cached
         import requests
 
         with self._spec_lock:
-            if self.pool.reference_spec is not None:
-                return self.pool.reference_spec
+            cached = (
+                pool.reference_spec if default
+                else pool.reference_specs.get(model)
+            )
+            if cached is not None:
+                return cached
             last_exc: Exception | None = None
-            for replica in self.pool.snapshot_ordered():
+            for replica in pool.snapshot_ordered():
                 try:
-                    replica.spec = self._fetch_spec(replica)
+                    spec = self._fetch_spec(replica, None if default else model)
+                except UpstreamError:
+                    raise  # a 404 is an answer (unknown model), not an outage
                 except requests.RequestException as e:
                     last_exc = e
                     continue
-                self.pool.reference_spec = replica.spec
-                return replica.spec
+                if default:
+                    replica.spec = spec
+                    pool.reference_spec = spec
+                else:
+                    replica.specs[model] = spec
+                    pool.reference_specs[model] = spec
+                return spec
             raise UpstreamError(
                 f"model spec discovery failed: {last_exc}"
             ) from last_exc
 
     def _fetch_one(self, url: str):
-        """url -> resized uint8 HWC image (host-side half of the pipeline)."""
-        spec = self.spec
+        """url -> resized uint8 HWC image (host-side half of the pipeline),
+        sized for the DEFAULT model (the single-argument surface tests
+        monkeypatch; _fetch_one_for is the model-aware variant)."""
+        return self._fetch_one_for(url, None)
+
+    def _fetch_one_for(self, url: str, model: str | None):
+        spec = self.spec_for(model)
         t0 = time.perf_counter()
         data = preprocess.fetch_image_bytes(url)
         image = preprocess.preprocess_bytes(
@@ -269,16 +359,21 @@ class Gateway:
         self._m_fetch.observe(time.perf_counter() - t0)
         return image
 
-    def _fetch_one_traced(self, url: str, trace=None):
+    def _fetch_one_traced(self, url: str, trace=None, model: str | None = None):
         """_fetch_one under a ``gateway.preprocess`` span.  Kept separate so
         _fetch_one's single-argument surface (which tests monkeypatch) stays
         stable whether or not the request is traced."""
+        if model is None:
+            fetch = self._fetch_one
+        else:
+            def fetch(u):
+                return self._fetch_one_for(u, model)
         if trace is None:
-            return self._fetch_one(url)
+            return fetch(url)
         with trace.span("gateway.preprocess"):
-            return self._fetch_one(url)
+            return fetch(url)
 
-    def _validate_replica_spec(self, replica) -> None:
+    def _validate_replica_spec(self, replica, model: str | None = None) -> None:
         """Failover spec re-validation: before a replica other than the
         reference source serves traffic, its contract must match the pool's
         reference -- a replica left serving a different model version
@@ -287,22 +382,34 @@ class Gateway:
         Only runs once a reference exists and only until the replica's spec
         is cached (it is re-cleared when the replica rejoins after being
         unhealthy).  RequestException propagates: an unreachable replica is
-        a connect failure, which the failover loop routes around.
+        a connect failure, which the failover loop routes around.  Checked
+        PER MODEL: each routed model's contract is validated independently.
         """
-        reference = self.pool.reference_spec
+        default = model is None or model == self.model
+        reference = (
+            self.pool.reference_spec if default
+            else self.pool.reference_specs.get(model)
+        )
         if reference is None:
             return
-        if replica.spec is None:
-            replica.spec = self._fetch_spec(replica)
-        if replica.spec.to_json() != reference.to_json():
+        if default:
+            if replica.spec is None:
+                replica.spec = self._fetch_spec(replica)
+            cached = replica.spec
+        else:
+            cached = replica.specs.get(model)
+            if cached is None:
+                cached = replica.specs[model] = self._fetch_spec(replica, model)
+        if cached.to_json() != reference.to_json():
             self.pool.mark_spec_mismatch(replica)
             raise UpstreamError(
                 f"model-tier replica {replica.host} serves a different "
-                f"model contract than the pool reference", 502,
+                f"model contract ({model or self.model!r}) than the pool "
+                "reference", 502,
             )
 
     def _post_once(self, replica, body, request_id, deadline, timeout,
-                   span_id: str = ""):
+                   span_id: str = "", model: str | None = None):
         """One upstream POST to one replica (headers re-measured now)."""
         if self._faults is not None:
             self._faults.fire("gateway.upstream")
@@ -314,14 +421,14 @@ class Gateway:
         if deadline is not None:  # remaining budget, re-measured now
             headers[DEADLINE_HEADER] = deadline.header_value()
         return self._session().post(
-            f"{replica.base}/v1/models/{self.model}:predict",
+            f"{replica.base}/v1/models/{model or self.model}:predict",
             data=body,
             headers=headers,
             timeout=timeout,
         )
 
     def _attempt_traced(self, replica, body, request_id, deadline, timeout,
-                        trace, role: str):
+                        trace, role: str, model: str | None = None):
         """One upstream POST recorded as a ``gateway.upstream`` span.
 
         Returns ``(response, span)``; on failure records the span with the
@@ -331,12 +438,15 @@ class Gateway:
         two distinguishable model-tier executions.
         """
         if trace is None:
-            return self._post_once(replica, body, request_id, deadline, timeout), None
+            return self._post_once(
+                replica, body, request_id, deadline, timeout, model=model
+            ), None
         sid = trace_lib.new_span_id()
         w0 = trace_lib.now_s()
         try:
             r = self._post_once(
-                replica, body, request_id, deadline, timeout, span_id=sid
+                replica, body, request_id, deadline, timeout, span_id=sid,
+                model=model,
             )
         except Exception as e:
             trace.tracer.record(
@@ -354,7 +464,7 @@ class Gateway:
 
     def _post_hedged(
         self, primary, body, request_id, deadline, timeout, tried,
-        trace=None, role: str = "primary",
+        trace=None, role: str = "primary", model: str | None = None,
     ):
         """POST with a deadline-budget-aware hedged second attempt.
 
@@ -385,7 +495,8 @@ class Gateway:
         )
         if not hedgeable:
             r, span = self._attempt_traced(
-                primary, body, request_id, deadline, timeout, trace, role
+                primary, body, request_id, deadline, timeout, trace, role,
+                model=model,
             )
             if span is not None:
                 span.tags["winner"] = True
@@ -397,7 +508,8 @@ class Gateway:
         def attempt(rep, rep_role):
             try:
                 r, span = self._attempt_traced(
-                    rep, body, request_id, deadline, timeout, trace, rep_role
+                    rep, body, request_id, deadline, timeout, trace, rep_role,
+                    model=model,
                 )
                 results.put((rep, r, None, span))
             except Exception as e:  # noqa: BLE001 - reported via the queue
@@ -476,7 +588,13 @@ class Gateway:
 
     @staticmethod
     def _status_error(r) -> UpstreamError:
-        """Map a non-200 upstream response to the client-facing error."""
+        """Map a non-200 upstream response to the client-facing error.
+        A 404 passes through: "no such model" is the caller's mistake
+        (bad route), not a tier outage dressed up as a 502."""
+        if r.status_code == 404:
+            return UpstreamError(
+                f"model server error 404: {r.text[:200]}", 404
+            )
         status = 503 if r.status_code == 503 else 502
         retry_after = None
         if status == 503:
@@ -496,6 +614,7 @@ class Gateway:
         request_id: str = "",
         deadline: Deadline | None = None,
         trace=None,
+        model: str | None = None,
     ) -> tuple[list, list[str]]:
         """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
 
@@ -561,11 +680,12 @@ class Gateway:
                 min(PREDICT_TIMEOUT_S, max(read_timeout, 0.05)), read_timeout
             )
             try:
-                self._validate_replica_spec(replica)
+                self._validate_replica_spec(replica, model)
                 replica, r = self._post_hedged(
                     replica, body, request_id, deadline, timeout, tried,
                     trace=trace,
                     role="failover" if tried else "primary",
+                    model=model,
                 )
             except (
                 requests.RequestException,
@@ -631,31 +751,34 @@ class Gateway:
         request_id: str = "",
         deadline: Deadline | None = None,
         trace=None,
+        model: str | None = None,
     ) -> dict[str, float]:
         """url -> {label: score}; the reference's apply_model
-        (reference model_server.py:52-56)."""
-        image = self._fetch_one_traced(url, trace)
-        if self._microbatcher is not None:
+        (reference model_server.py:52-56).  ``model`` routes to a
+        non-default served model (multi-model registry)."""
+        image = self._fetch_one_traced(url, trace, model=model)
+        microbatcher = self._microbatcher_for(model)
+        if microbatcher is not None:
             # Micro-batched flushes coalesce MANY requests' upstream hop
             # into one POST; the upstream attempt is not attributable to a
             # single request's subtree, so the trace records the wait as
             # one span instead.
             if trace is None:
-                row, labels = self._microbatcher.predict(
+                row, labels = microbatcher.predict(
                     image,
                     request_id,
                     timeout=None if deadline is None else deadline.remaining_s(),
                 )
             else:
                 with trace.span("gateway.microbatch"):
-                    row, labels = self._microbatcher.predict(
+                    row, labels = microbatcher.predict(
                         image,
                         request_id,
                         timeout=None if deadline is None else deadline.remaining_s(),
                     )
             return dict(zip(labels, map(float, row)))
         logits, labels = self._predict_batch(
-            image[None], request_id, deadline, trace
+            image[None], request_id, deadline, trace, model=model
         )
         return dict(zip(labels, map(float, logits[0])))
 
@@ -665,6 +788,7 @@ class Gateway:
         request_id: str = "",
         deadline: Deadline | None = None,
         trace=None,
+        model: str | None = None,
     ) -> list[dict]:
         """urls -> per-url {label: score} or {"error": ...}, order-preserving.
 
@@ -683,10 +807,10 @@ class Gateway:
             raise ValueError(
                 f"{len(urls)} urls exceeds the {MAX_URLS_PER_REQUEST}-url limit"
             )
-        self.spec  # discover upstream contract FIRST: outage => 502, not 200
+        self.spec_for(model)  # discover contract FIRST: outage => 502, not 200
         with ThreadPoolExecutor(max_workers=min(len(urls), MAX_BATCH_FETCHERS)) as ex:
             fetched = list(
-                ex.map(lambda u: self._fetch_one_safe(u, trace), urls)
+                ex.map(lambda u: self._fetch_one_safe(u, trace, model), urls)
             )
         good = [(i, img) for i, (img, _) in enumerate(fetched) if img is not None]
         results: list[dict] = [
@@ -696,15 +820,16 @@ class Gateway:
             import numpy as np
 
             logits, labels = self._predict_batch(
-                np.stack([img for _, img in good]), request_id, deadline, trace
+                np.stack([img for _, img in good]), request_id, deadline,
+                trace, model=model,
             )
             for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
         return results
 
-    def _fetch_one_safe(self, url: str, trace=None):
+    def _fetch_one_safe(self, url: str, trace=None, model: str | None = None):
         try:
-            return self._fetch_one_traced(url, trace), None
+            return self._fetch_one_traced(url, trace, model=model), None
         except UpstreamError:
             raise  # model-tier trouble is the request's failure, not the URL's
         except Exception as e:
@@ -787,6 +912,7 @@ class Gateway:
         body: bytes,
         request_id: str | None = None,
         deadline: Deadline | None = None,
+        model: str | None = None,
     ) -> tuple[int, bytes, str, dict[str, str]]:
         """POST /predict body -> (status, body, content_type, extra_headers).
 
@@ -796,16 +922,27 @@ class Gateway:
         and the log line is the same one.  ``deadline`` is the request's
         parsed deadline budget (transports build it from the
         X-Request-Deadline-Ms header when admission is enabled); the extra
-        headers carry Retry-After on shed/overload responses.
+        headers carry Retry-After on shed/overload responses.  ``model``
+        is the transports' resolved route target (resolve_model); None
+        keeps the default model and the exact single-model code path.
         """
         t0 = time.perf_counter()
         rid = request_id or ensure_request_id(None)
+        # Normalize: the default model rides the legacy (model=None) path
+        # end to end, so single-model deployments are bit-for-bit the old
+        # gateway; only genuinely non-default routes carry a name.
+        if model is not None and model == self.model:
+            model = None
+        routed = model or self.model
         # This request's trace (trace id = rid): the root span carrier every
         # child span -- admission, preprocess, upstream attempts -- nests
         # under, and the key /debug/trace/<rid> serves the waterfall by.
         rt = self.tracer.request_trace(rid)
         w_start = trace_lib.now_s()
         self._m_requests.inc()
+        # Per-model request count (bounded `model` label, minted centrally):
+        # the route is sanitized by resolve_model before it reaches here.
+        metrics_lib.model_request_counter(self.registry, routed).inc()
         status = 500
         n_urls = 1
         ticket = None
@@ -814,7 +951,7 @@ class Gateway:
                 deadline = Deadline.default()
             try:
                 with rt.span("gateway.admission"):
-                    ticket = self.admission.admit(deadline)
+                    ticket = self.admission.admit(deadline, model=routed)
             except Shed as e:
                 self._m_errors.inc()
                 status = e.http_status
@@ -826,10 +963,14 @@ class Gateway:
                 # reference's schema (reference test.py:15) and unchanged
                 urls = list(req["urls"])
                 n_urls = len(urls)
-                preds = self.apply_model_batch(urls, rid, deadline, trace=rt)
+                preds = self.apply_model_batch(
+                    urls, rid, deadline, trace=rt, model=model
+                )
                 status = 200
                 return 200, json.dumps({"predictions": preds}).encode(), "application/json", {}
-            scores = self.apply_model(req["url"], rid, deadline, trace=rt)
+            scores = self.apply_model(
+                req["url"], rid, deadline, trace=rt, model=model
+            )
             status = 200
             return 200, json.dumps(scores).encode(), "application/json", {}
         except UpstreamError as e:
@@ -917,9 +1058,18 @@ class Gateway:
 
             def do_POST(self):
                 rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
-                if self.path != "/predict":
+                path = self.path.split("?", 1)[0]
+                if path != "/predict" and not path.startswith("/predict/"):
                     return self._send(
                         404, b'{"error": "not found"}', "application/json", rid
+                    )
+                # Model routing: /predict/<model> or X-Kdlt-Model; the bare
+                # /predict keeps the reference's shape (default model).
+                model = gw.resolve_model(path, self.headers.get(MODEL_HEADER))
+                if model is None:
+                    return self._send(
+                        404, b'{"error": "malformed model name"}',
+                        "application/json", rid,
                     )
                 length = int(self.headers.get("Content-Length", 0))
                 rejected = gw.reject_oversize(length)
@@ -934,7 +1084,7 @@ class Gateway:
                     else None
                 )
                 status, out, ctype, extra = gw.handle_predict(
-                    self.rfile.read(length), rid, deadline
+                    self.rfile.read(length), rid, deadline, model=model
                 )
                 # Server-Timing-style span summary; handle_predict has
                 # recorded the full trace (root included) by return time.
@@ -966,6 +1116,10 @@ class Gateway:
     def shutdown(self) -> None:
         if self._microbatcher is not None:
             self._microbatcher.close()
+        with self._microbatcher_lock:
+            for mb in self._microbatchers.values():
+                mb.close()
+            self._microbatchers.clear()
         self.pool.close()
         if self._httpd is None:
             return

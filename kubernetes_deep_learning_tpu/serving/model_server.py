@@ -54,6 +54,7 @@ from kubernetes_deep_learning_tpu.runtime import (
     QueueFull,
     create_batcher,
     resolve_pipeline_depth,
+    resolve_weights,
 )
 from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 from kubernetes_deep_learning_tpu.serving.admission import (
@@ -79,6 +80,7 @@ from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+_STATUS_RE = re.compile(r"^/v1/models/([^/:]+):status$")
 _MODEL_RE = re.compile(r"^/v1/models/([^/:]+)$")
 
 DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-model-service.yaml:9-10)
@@ -90,20 +92,30 @@ class ServedModel:
     def __init__(
         self, artifact, buckets, max_delay_ms, registry, use_batcher=True,
         batcher_impl="auto", mesh=None, mesh_mode="data", engine_factory=None,
-        pipeline_depth=None,
+        pipeline_depth=None, scheduler=None, weight=None,
     ):
         # engine_factory: swap the execution engine (default InferenceEngine).
         # runtime.stub.StubEngine measures the host path with the device
         # taken out (bench.py --host-saturation).
+        # scheduler: the server's shared UnifiedScheduler (runtime.scheduler)
+        # -- when set and the engine supports async dispatch, this model
+        # serves through a per-model scheduling lane + the tier's ONE shared
+        # InFlightDispatcher instead of a private batcher/dispatcher pair,
+        # so device time is arbitrated ACROSS models (weight = this model's
+        # share in that arbitration).
         engine_factory = engine_factory or InferenceEngine
         self.artifact = artifact
+        self.name = artifact.spec.name
         self.version = int(artifact.path.rstrip("/").rsplit("/", 1)[-1])
+        # The registry's identity key (sha256 of the artifact dir); stamped
+        # by ModelRegistry.poll after a successful load.
+        self.artifact_hash: str | None = None
         # Each model version gets a labeled child registry so two models (or
         # two versions across a hot reload) never emit colliding series on
         # the shared /metrics page; the child is dropped when the version is
         # unloaded (ModelServer.poll_versions).
-        self.registry_child = registry.with_labels(
-            model=artifact.spec.name, version=str(self.version)
+        self.registry_child = metrics_lib.model_version_registry(
+            registry, artifact.spec.name, self.version
         )
         # The deadline budget handed to the batcher/dispatcher wait, in ms:
         # the last hop of the gateway -> model tier -> batcher propagation
@@ -120,43 +132,76 @@ class ServedModel:
                 artifact, buckets=buckets, registry=self.registry_child,
                 mesh=mesh, mesh_mode=mesh_mode,
             )
-            # ONE in-flight dispatch pipeline per model version, shared by
-            # the single-image batcher and the chunked multi-image path, so
-            # both draw from the same bounded in-flight budget (the device
-            # runs one program at a time regardless of which path enqueued
-            # it).  None when depth=1 (serial) or the engine has no async
-            # dispatch hook (e.g. the plain StubEngine).  An engine that
-            # carries its own budget (CrossHostEngine: the fleet-wide
-            # KDLT_XH_PIPELINE_DEPTH) overrides the per-chip default so the
-            # dispatcher's backpressure matches the protocol's.
-            depth = getattr(self.engine, "preferred_pipeline_depth", None)
-            if depth is None:
-                depth = resolve_pipeline_depth(pipeline_depth)
-            self.dispatcher = (
-                InFlightDispatcher(
-                    self.engine, depth=depth, registry=self.registry_child
+            # Scheduler mode: the model's device work rides a scheduling
+            # lane on the shared dispatcher.  Engines that carry their OWN
+            # in-flight budget (CrossHostEngine: the fleet-wide
+            # KDLT_XH_PIPELINE_DEPTH is a protocol parameter every process
+            # must agree on) keep a dedicated dispatcher instead, as do
+            # engines with no async hook (plain StubEngine: there is no
+            # device pipeline to arbitrate).
+            self._scheduler = None
+            self._max_delay_ms = max_delay_ms
+            self._weight = weight
+            if (
+                scheduler is not None
+                and use_batcher
+                and hasattr(self.engine, "predict_async")
+                and getattr(self.engine, "preferred_pipeline_depth", None) is None
+            ):
+                self._scheduler = scheduler
+                self.dispatcher = None
+                self.batcher = None
+            else:
+                # Legacy per-model pipeline: ONE in-flight dispatch pipeline
+                # per model version, shared by the single-image batcher and
+                # the chunked multi-image path, so both draw from the same
+                # bounded in-flight budget.  None when depth=1 (serial) or
+                # the engine has no async dispatch hook.
+                depth = getattr(self.engine, "preferred_pipeline_depth", None)
+                if depth is None:
+                    depth = resolve_pipeline_depth(pipeline_depth)
+                self.dispatcher = (
+                    InFlightDispatcher(
+                        self.engine, depth=depth, registry=self.registry_child
+                    )
+                    if depth > 1 and hasattr(self.engine, "predict_async")
+                    else None
                 )
-                if depth > 1 and hasattr(self.engine, "predict_async")
-                else None
-            )
-            self.batcher = (
-                create_batcher(
-                    self.engine,
-                    impl=batcher_impl,
-                    max_delay_ms=max_delay_ms,
-                    registry=self.registry_child,
-                    pipeline_depth=depth,
-                    dispatcher=self.dispatcher,
+                self.batcher = (
+                    create_batcher(
+                        self.engine,
+                        impl=batcher_impl,
+                        max_delay_ms=max_delay_ms,
+                        registry=self.registry_child,
+                        pipeline_depth=depth,
+                        dispatcher=self.dispatcher,
+                    )
+                    if use_batcher
+                    else None
                 )
-                if use_batcher
-                else None
-            )
         except BaseException:
             # with_labels already hooked the child into the shared registry;
             # a failed construction must not leave the orphan behind (the
             # version watcher retries every poll).
             registry.remove(self.registry_child)
             raise
+
+    def activate(self) -> None:
+        """Flip live routing to this version's engine.
+
+        In scheduler mode this registers/swaps the model's scheduling lane
+        -- called AFTER warmup, so the lane never routes to a cold engine
+        (the warmed-before-swap contract), and called BEFORE the models
+        dict rebinds, so there is no window where a handler resolved this
+        ServedModel but the lane still points at the predecessor.  Queued
+        requests survive the swap: lanes are engine-agnostic until
+        dispatch.  No-op in legacy batcher mode (construction already wired
+        the private batcher)."""
+        if self._scheduler is not None:
+            self._scheduler.register(
+                self.name, self.engine, weight=self._weight,
+                max_delay_ms=self._max_delay_ms,
+            )
 
     def predict(
         self,
@@ -179,6 +224,35 @@ class ServedModel:
             self._m_batcher_budget.observe(remaining * 1e3)
             batcher_timeout = min(batcher_timeout, remaining)
             future_timeout = min(future_timeout, remaining)
+        max_b = self.engine.max_batch
+        if self._scheduler is not None and images.dtype == np.uint8:
+            # Scheduler mode: EVERY uint8 batch rides the shared scheduler
+            # -- single images coalesce in the model's lane, pre-formed
+            # batches enter as indivisible chunks -- so cross-model
+            # arbitration covers all device work, not just the single-image
+            # path.  Bucket padding/dispatch is unchanged underneath
+            # (engine.predict_async), so logits stay bit-identical to
+            # single-model serving.
+            try:
+                if images.shape[0] == 1:
+                    return self._scheduler.submit(
+                        self.name, images[0], deadline=deadline, trace=trace
+                    ).result(timeout=batcher_timeout)[None]
+                futs = [
+                    self._scheduler.submit_batch(
+                        self.name, images[i : i + max_b],
+                        deadline=deadline, trace=trace,
+                    )
+                    for i in range(0, images.shape[0], max_b)
+                ]
+                return np.concatenate(
+                    [f.result(timeout=future_timeout) for f in futs]
+                )
+            except BatcherClosed:
+                # Shutdown/unload race: the lane is gone but this handler
+                # still holds the engine -- serve it directly rather than
+                # surfacing a client-visible 500.
+                pass
         # Multi-image requests go straight to the engine (they are already a
         # batch); single uint8 images go through the batcher to coalesce
         # across concurrent requests (the batcher is uint8-only so mixed
@@ -198,7 +272,6 @@ class ServedModel:
                 # still valid, so the in-flight request must not become
                 # a client-visible 500.
                 pass
-        max_b = self.engine.max_batch
         if images.shape[0] <= max_b:
             if trace is not None:
                 with trace.span("engine.predict", batch=int(images.shape[0])):
@@ -232,6 +305,11 @@ class ServedModel:
         )
 
     def close(self, drain: bool = True) -> None:
+        if self._scheduler is not None:
+            # Drop the lane only if this engine still owns it: a superseded
+            # version's close after a hot-swap is a no-op (the lane -- and
+            # its queued requests -- belong to the replacement).
+            self._scheduler.unregister(self.name, engine=self.engine)
         if self.batcher is not None:
             self.batcher.close(drain=drain)
         if self.dispatcher is not None:
@@ -258,6 +336,8 @@ class ModelServer:
         engine_factory=None,
         pipeline_depth: int | None = None,
         admission: bool | None = None,
+        sched_policy: str | None = None,
+        sched_weights: dict[str, float] | None = None,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -323,7 +403,6 @@ class ModelServer:
                 if admission_enabled(admission) else None
             ),
         )
-        self.models: dict[str, ServedModel] = {}
         self.model_root = model_root
         self._buckets = buckets
         self._max_delay_ms = max_delay_ms
@@ -333,10 +412,33 @@ class ModelServer:
         self._mesh_mode = mesh_mode
         self._engine_factory = engine_factory
         self._pipeline_depth = pipeline_depth
+        # Unified SLO-aware scheduling core (runtime.scheduler): ONE queue/
+        # scheduler for every served model, arbitrating the shared
+        # dispatcher's device time by deadline budget + per-model weights
+        # ($KDLT_SCHED_POLICY / $KDLT_SCHED_WEIGHTS).  batcher_impl
+        # "native" opts out: the C++ ticket queue is a single-model
+        # GIL-free fast path and keeps its private pipeline.
+        self.scheduler = None
+        if use_batcher and batcher_impl != "native":
+            from kubernetes_deep_learning_tpu.runtime import UnifiedScheduler
+
+            self.scheduler = UnifiedScheduler(
+                registry=self.registry,
+                policy=sched_policy,
+                weights=sched_weights,
+                pipeline_depth=pipeline_depth,
+            )
+        # Multi-model registry (serving.registry): scans the artifact root
+        # for EVERY model's highest version, keys loads by artifact hash,
+        # owns the name -> ServedModel map the handlers route by.
+        from kubernetes_deep_learning_tpu.serving.registry import ModelRegistry
+
+        self.model_registry = ModelRegistry(
+            model_root, loader=self._load_model, unloader=self._unload_model
+        )
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self._profile_lock = threading.Lock()
-        self._poll_lock = threading.Lock()  # serializes version scans
         self.poll_versions()
         if not self.models:
             raise FileNotFoundError(f"no model artifacts under {model_root!r}")
@@ -355,12 +457,19 @@ class ModelServer:
         return all(m.engine.ready for m in self.models.values())
 
     @property
+    def models(self) -> dict[str, ServedModel]:
+        """The name -> ServedModel routing map (owned by the registry)."""
+        return self.model_registry.models
+
+    @property
     def stalled(self) -> bool:
-        """True once any model's dispatch watchdog declared the in-flight
-        pipeline stuck.  /healthz follows this flag: a wedged device sync
-        cannot be recovered in-process, so the orchestrator must restart
-        the pod (liveness probe failure), while the gateway's replica pool
-        routes around it in the meantime."""
+        """True once any dispatch watchdog declared an in-flight pipeline
+        stuck.  /healthz follows this flag: a wedged device sync cannot be
+        recovered in-process, so the orchestrator must restart the pod
+        (liveness probe failure), while the gateway's replica pool routes
+        around it in the meantime."""
+        if self.scheduler is not None and self.scheduler.stalled:
+            return True
         return any(
             m.dispatcher is not None and m.dispatcher.stalled
             for m in self.models.values()
@@ -376,79 +485,58 @@ class ModelServer:
         which the reference ships but never exercises (it redeploys the image
         instead, reference tf-serving.dockerfile:5).  Serves as both the
         initial load (from __init__) and the watcher's periodic scan.
-
-        Concurrency contract: a new version is fully loaded and **warmed
-        before the swap**, so serving never routes to a cold engine; the
-        swap rebinds ``self.models`` to a fresh dict (copy-on-write), so
-        handler threads iterating the old snapshot never see a mutation.
-        Scans themselves are serialized on a lock: with the watcher thread
-        AND the gRPC ModelService reload RPC both calling in (round 4),
-        two concurrent scans would each snapshot ``self.models``, double-
-        load/warm the same version, and the loser's stale-snapshot swap
-        could resurrect an already-closed engine.
-        Layout invariant: the artifact's spec.name must equal its directory
-        name -- it is the serving key, URL path, and version-comparison key
-        at once; mismatched artifacts are skipped loudly.  Returns "name vN"
-        per swap.
+        Scan/compare/swap live in serving.registry.ModelRegistry (scans
+        serialized, copy-on-write swaps, artifact-hash dedupe); this server
+        owns only the ServedModel construction below.
         """
-        with self._poll_lock:
-            return self._poll_versions_locked()
+        return self.model_registry.poll()
 
-    def _poll_versions_locked(self) -> list[str]:
-        import os
+    def _load_model(self, name: str, version: int, directory: str):
+        """ModelRegistry loader: construct, warm, and ACTIVATE one version.
 
-        updated: list[str] = []
-        names = (
-            sorted(os.listdir(self.model_root))
-            if os.path.isdir(self.model_root)
-            else []
+        The version is fully loaded and warmed before activation, so
+        serving never routes to a cold engine; activation (the scheduling-
+        lane swap) happens here, before the registry rebinds its models
+        dict.  Layout invariant: the artifact's spec.name must equal its
+        directory name -- it is the serving key, URL path, and version-
+        comparison key at once; mismatched artifacts are skipped loudly.
+        """
+        artifact = art.load_artifact(directory)
+        if artifact.spec.name != name:
+            print(
+                f"version watcher: skipping {directory}: spec.name "
+                f"{artifact.spec.name!r} != directory name {name!r}"
+            )
+            return None
+        fresh = ServedModel(
+            artifact,
+            self._buckets,
+            self._max_delay_ms,
+            self.registry,
+            self._use_batcher,
+            self._batcher_impl,
+            self._mesh,
+            self._mesh_mode,
+            self._engine_factory,
+            self._pipeline_depth,
+            scheduler=self.scheduler,
         )
-        for name in names:
-            version = art.latest_version(self.model_root, name)
-            if version is None:
-                continue
-            current = self.models.get(name)
-            if current is not None and current.version >= version:
-                continue
-            directory = art.version_dir(self.model_root, name, version)
-            fresh = None
-            try:
-                artifact = art.load_artifact(directory)
-                if artifact.spec.name != name:
-                    print(
-                        f"version watcher: skipping {directory}: spec.name "
-                        f"{artifact.spec.name!r} != directory name {name!r}"
-                    )
-                    continue
-                fresh = ServedModel(
-                    artifact,
-                    self._buckets,
-                    self._max_delay_ms,
-                    self.registry,
-                    self._use_batcher,
-                    self._batcher_impl,
-                    self._mesh,
-                    self._mesh_mode,
-                    self._engine_factory,
-                    self._pipeline_depth,
-                )
-                fresh.engine.warmup()
-            except Exception as e:
-                # A half-written or broken version dir must never take down
-                # the serving versions; skip and retry on the next poll.
-                if fresh is not None:  # warmup failed post-construction
-                    fresh.close()
-                    self.registry.remove(fresh.registry_child)
-                print(f"version watcher: skipping {name} v{version}: {e}", file=sys.stderr)
-                continue
-            old = self.models.get(name)
-            self.models = {**self.models, name: fresh}
-            if old is not None:
-                old.close()
-                self.registry.remove(old.registry_child)
-            updated.append(f"{name} v{version}")
-            print(f"loaded {name} v{version} from {directory}", file=sys.stderr)
-        return updated
+        try:
+            fresh.engine.warmup()
+        except Exception:
+            # Warmup failed post-construction: the registry skips this
+            # version (and retries next poll); the orphaned child registry
+            # must not leak series onto /metrics.
+            fresh.close()
+            self.registry.remove(fresh.registry_child)
+            raise
+        fresh.activate()
+        return fresh
+
+    def _unload_model(self, old: ServedModel) -> None:
+        """ModelRegistry unloader for a superseded version."""
+        old.close()
+        self.registry.remove(old.registry_child)
 
     def start_version_watcher(self, interval_s: float = 10.0) -> None:
         """Poll the artifact root for new versions in a daemon thread."""
@@ -591,13 +679,18 @@ class ModelServer:
                     # of the POST endpoint below (same capture, same lock).
                     return self._profile()
                 if self.path == "/v1/models":
-                    return self._send_json(
-                        200,
-                        {
-                            name: {"version": m.version, "ready": m.engine.ready}
-                            for name, m in server.models.items()
-                        },
-                    )
+                    # The registry's multi-model status page: per model
+                    # {version, ready, artifact_hash, buckets, family,
+                    # labels} -- version/ready keep the original contract.
+                    return self._send_json(200, server.model_registry.status())
+                m = _STATUS_RE.match(self.path)
+                if m:
+                    status = server.model_registry.model_status(m.group(1))
+                    if status is None:
+                        return self._send_json(
+                            404, {"error": f"no model {m.group(1)!r}"}
+                        )
+                    return self._send_json(200, status)
                 m = _MODEL_RE.match(self.path)
                 if m:
                     model = server.models.get(m.group(1))
@@ -640,6 +733,13 @@ class ModelServer:
                     server._m_errors.inc()
                     self._discard_body()
                     return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
+                # Per-model request count (bounded `model` label, minted
+                # centrally): only REGISTERED model names reach here, so
+                # the label's value set is the registry's scan, not client
+                # input.
+                metrics_lib.model_request_counter(
+                    server.registry, m.group(1)
+                ).inc()
                 # The propagated deadline budget (gateway or deadline-aware
                 # client); parsed only when admission is on so the disabled
                 # posture is exactly the legacy fixed-timeout behavior.
@@ -654,7 +754,9 @@ class ModelServer:
                     # exhausted or shed request must cost no decode work and
                     # never touch the TPU.
                     with rt.span("server.admission"):
-                        ticket = server.admission.admit(deadline)
+                        ticket = server.admission.admit(
+                            deadline, model=m.group(1)
+                        )
                     if server._faults is not None:
                         # server.predict fault point: error/latency/hang/
                         # disconnect strike the handler here (admitted, body
@@ -880,6 +982,8 @@ class ModelServer:
         self._httpd.server_close()
         for m in self.models.values():
             m.close(drain=False)
+        if self.scheduler is not None:
+            self.scheduler.close(drain=False)
 
 
 def _serve_cross_host(args) -> int:
@@ -972,14 +1076,29 @@ def _serve_cross_host(args) -> int:
 
 
 def _single_model_name(model_root: str) -> tuple[str]:
-    """Cross-host serving drives exactly one model; resolve its name."""
+    """Cross-host serving drives exactly one model; resolve its name.
+
+    The error paths are explicit and actionable (a bare tuple-unpack
+    failure at the call site told an operator nothing): an empty root and
+    a multi-entry root are different mistakes with different fixes.  For
+    multi-model roots, the standard (non-cross-host) server is the path --
+    its ModelRegistry serves every model concurrently.
+    """
     names = [
         n for n in sorted(os.listdir(model_root))
         if art.latest_version(model_root, n) is not None
     ]
-    if len(names) != 1:
+    if not names:
         raise ValueError(
-            f"--cross-host serves exactly one model; {model_root!r} has {names}"
+            f"--cross-host found no versioned model under {model_root!r} "
+            "(expected <root>/<name>/<version>/ with an exported artifact)"
+        )
+    if len(names) > 1:
+        raise ValueError(
+            f"--cross-host serves exactly one model, but {model_root!r} "
+            f"holds {len(names)}: {names}.  Either point --models at a "
+            "single-model root, or drop --cross-host to serve them all "
+            "from one process (the multi-model registry + scheduler path)"
         )
     return (names[0],)
 
@@ -1087,6 +1206,21 @@ def main(argv: list[str] | None = None) -> int:
              "round exceeds this many seconds (dead follower); 0 disables",
     )
     p.add_argument(
+        "--sched-policy",
+        default=None,
+        choices=["weighted_deadline", "fifo"],
+        help="cross-model arbitration policy for the unified scheduler "
+        "(default $KDLT_SCHED_POLICY or weighted_deadline): "
+        "weighted_deadline = earliest effective deadline with per-model "
+        "weight floors; fifo = naive arrival order (the A/B baseline)",
+    )
+    p.add_argument(
+        "--sched-weights",
+        default=None,
+        help='per-model scheduling weights, e.g. "clothing-model=2,vit=1" '
+        "(default $KDLT_SCHED_WEIGHTS; unlisted models weigh 1.0)",
+    )
+    p.add_argument(
         "--no-admission",
         action="store_true",
         help="disable admission control (deadline rejection + AIMD "
@@ -1159,6 +1293,11 @@ def main(argv: list[str] | None = None) -> int:
         request_log=not args.no_request_log,
         pipeline_depth=args.pipeline_depth or None,
         admission=False if args.no_admission else None,
+        sched_policy=args.sched_policy,
+        sched_weights=(
+            None if args.sched_weights is None
+            else resolve_weights(args.sched_weights)
+        ),
     )
     # SIGTERM -> flip /readyz, stop admission, let in-flight batches finish,
     # then stop; fits inside the k8s terminationGracePeriodSeconds budget.

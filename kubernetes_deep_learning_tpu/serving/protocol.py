@@ -25,6 +25,13 @@ import numpy as np
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
 JSON_CONTENT_TYPE = "application/json"
 
+# Multi-model routing header: names the served model a /predict request
+# targets when the URL path carries no model segment (the gateway's
+# /predict/<model> form wins when both are present).  Lives here -- the
+# wire-contract module -- so the dependency-light client never has to
+# import the gateway to spell it.
+MODEL_HEADER = "X-Kdlt-Model"
+
 
 def encode_tensor(arr: np.ndarray) -> dict[str, Any]:
     arr = np.ascontiguousarray(arr)

@@ -79,7 +79,11 @@ class UpstreamReplica:
         self.breaker = CircuitBreaker()
         self.healthy = True
         self.consecutive_failures = 0
-        self.spec = None  # the replica's discovered ModelSpec, lazily fetched
+        self.spec = None  # the DEFAULT model's discovered ModelSpec
+        # Non-default models' contracts (multi-model routing), keyed by
+        # model name; cleared with ``spec`` when the replica rejoins so
+        # every contract is re-validated before serving again.
+        self.specs: dict[str, object] = {}
         self._gauge = (
             metrics_lib.replica_healthy_gauge(registry, host)
             if registry is not None
@@ -127,7 +131,9 @@ class UpstreamPool:
         self.probe_interval_s = probe_interval_s
         self._unhealthy_after = max(1, unhealthy_after)
         self.replicas = [UpstreamReplica(h, registry) for h in hosts]
-        self.reference_spec = None  # first discovered contract; all must match
+        self.reference_spec = None  # the default model's reference contract
+        # Non-default models' reference contracts (multi-model routing).
+        self.reference_specs: dict[str, object] = {}
         self._lock = threading.Lock()
         self._rr = 0
         m = (
@@ -274,6 +280,7 @@ class UpstreamPool:
                 with self._lock:
                     r.consecutive_failures = 0
                     r.spec = None
+                    r.specs.clear()
                     r.set_healthy(True)
                 r.breaker.reset()
 

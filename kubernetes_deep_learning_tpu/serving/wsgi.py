@@ -48,16 +48,27 @@ class GatewayWSGI:
             ensure_request_id,
         )
 
+        from kubernetes_deep_learning_tpu.serving.gateway import WSGI_MODEL_KEY
+
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
         rid = ensure_request_id(environ.get("HTTP_X_REQUEST_ID"))
         extra: dict[str, str] = {}
         if method == "GET":
             code, body, ctype = self.gateway.handle_get(path)
-        elif method == "POST" and path == "/predict":
+        elif method == "POST" and (
+            path == "/predict" or path.startswith("/predict/")
+        ):
+            # Same model routing as the threaded transport: path segment
+            # first, X-Kdlt-Model header second, default model otherwise.
+            model = self.gateway.resolve_model(path, environ.get(WSGI_MODEL_KEY))
             length = int(environ.get("CONTENT_LENGTH") or 0)
             rejected = self.gateway.reject_oversize(length)
-            if rejected is not None:
+            if model is None:
+                code, body, ctype = (
+                    404, b'{"error": "malformed model name"}', "application/json"
+                )
+            elif rejected is not None:
                 code, body, ctype = rejected  # body stays unread; gunicorn
                 # discards the connection on its own
             else:
@@ -67,7 +78,8 @@ class GatewayWSGI:
                     else None
                 )
                 code, body, ctype, extra = self.gateway.handle_predict(
-                    environ["wsgi.input"].read(length), rid, deadline
+                    environ["wsgi.input"].read(length), rid, deadline,
+                    model=model,
                 )
                 # Same span-summary header as the threaded transport.
                 summary = self.gateway.tracer.summary(rid)

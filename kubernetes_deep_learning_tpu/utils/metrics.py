@@ -48,7 +48,7 @@ PIPELINE_STAGES = (
 
 
 def pipeline_stage_histograms(
-    registry: "Registry", engine: str | None = None
+    registry: "Registry", engine: str | None = None, model: str | None = None
 ) -> dict:
     """The per-stage histograms every in-flight dispatcher emits.
 
@@ -57,15 +57,170 @@ def pipeline_stage_histograms(
     and dashboards/alerts need one set of queries.  ``engine`` labels the
     series (engine="crosshost" for the cross-host dispatch pipeline) so
     one dashboard separates per-chip dispatch from fleet rounds; None
-    keeps the unlabeled single-host series.
+    keeps the unlabeled single-host series.  ``model`` adds the bounded
+    serving-model label (multi-model scheduling: the SHARED dispatcher
+    attributes each batch's stage time to the model that dispatched it);
+    callers must memoize per model -- re-minting the same (name, labels)
+    pair is a registry error by design.
     """
     if engine:
         registry = registry.with_labels(engine=engine)
+
+    def mint(reg):
+        return {
+            stage: reg.histogram(
+                f"kdlt_pipeline_{stage}_seconds", help,
+                buckets=PIPELINE_STAGE_BUCKETS,
+            )
+            for stage, help in PIPELINE_STAGES
+        }
+
+    if model is None:
+        return mint(registry)
+    return _memo_on_child(
+        model_registry(registry, model), "_kdlt_pipeline_stages", mint
+    )
+
+
+# --- the bounded ``model`` label (multi-model serving) ----------------------
+#
+# Every per-model series on a shared /metrics page carries a ``model`` label
+# minted HERE and nowhere else (tools/check_metrics.py lints for stray
+# with_labels(model=...) calls).  Central minting is what keeps the label's
+# cardinality bounded: values come from the model registry's directory scan,
+# and even a hostile/buggy caller cannot mint more than MODEL_LABEL_CAP
+# distinct values per root registry -- the overflow bucket absorbs the rest
+# instead of growing the exposition without bound.
+
+MODEL_LABEL_CAP = 32
+MODEL_LABEL_OVERFLOW = "__other__"
+
+_model_children_lock = threading.Lock()
+
+
+def model_registry(registry: "Registry", model: str) -> "Registry":
+    """The child registry carrying the bounded ``model`` label.
+
+    Memoized per root registry (the same model always lands on the same
+    child, so helpers minting through it dedupe naturally); past
+    MODEL_LABEL_CAP distinct models every further name collapses into the
+    MODEL_LABEL_OVERFLOW bucket.
+    """
+    model = str(model)
+    with _model_children_lock:
+        children = getattr(registry, "_kdlt_model_children", None)
+        if children is None:
+            children = {}
+            registry._kdlt_model_children = children
+        if model not in children:
+            if len(children) >= MODEL_LABEL_CAP:
+                model = MODEL_LABEL_OVERFLOW
+                if model in children:
+                    return children[model]
+            children[model] = registry.with_labels(model=model)
+        return children[model]
+
+
+def model_version_registry(
+    registry: "Registry", model: str, version: int
+) -> "Registry":
+    """A served model VERSION's labeled child registry (one per ServedModel;
+    dropped via registry.remove on unload, so version is not
+    cardinality-bounded the way ``model`` is -- at most one version per
+    model is live at a time)."""
+    return registry.with_labels(model=model, version=str(version))
+
+
+def _memo_on_child(child: "Registry", attr: str, factory):
+    """Mint-once-per-child memoization for the model-labeled helpers.
+
+    Two distinct raw model names can land on the SAME child registry (the
+    overflow bucket), so memoizing by raw name in the caller is not enough
+    -- the second name would re-mint the same (name, labels) series and
+    raise.  Stamping the minted dict on the child itself makes every
+    helper idempotent per label set.
+    """
+    with _model_children_lock:
+        got = getattr(child, attr, None)
+        if got is None:
+            got = factory(child)
+            setattr(child, attr, got)
+        return got
+
+
+def model_request_counter(registry: "Registry", model: str) -> "Counter":
+    """Per-model request count on a tier's /metrics page (bounded label)."""
+    child = model_registry(registry, model)
+    return _memo_on_child(
+        child, "_kdlt_model_requests", lambda c: c.counter(
+            "kdlt_model_requests_total", "predict requests by served model"
+        ),
+    )
+
+
+def admission_model_metrics(registry: "Registry", model: str) -> dict:
+    """Per-model admission accounting (requests seen / admitted), the
+    model-granular slice of the kdlt_admission_* contract.  The registry
+    passed in is the controller's tier-labeled registry, so the series is
+    distinguished by (tier, model)."""
+    child = model_registry(registry, model)
+    return _memo_on_child(
+        child, "_kdlt_admission_model", lambda c: {
+            "requests": c.counter(
+                "kdlt_admission_requests_total",
+                "requests seen by admission control",
+            ),
+            "admitted": c.counter(
+                "kdlt_admission_admitted_total",
+                "requests admitted to execution",
+            ),
+        },
+    )
+
+
+def scheduler_lane_metrics(registry: "Registry", model: str) -> dict:
+    """One scheduling lane's series (runtime.scheduler.UnifiedScheduler).
+
+    kdlt_batcher_batch_size keeps the historical batcher series name (the
+    invariant dashboard contract) under the model label; the kdlt_sched_*
+    series are the scheduler's own: queue depth, dispatch count, the
+    weight-floor starvation guard, and estimated device-time consumption
+    (the share the weighted policy arbitrates).
+    """
+    child = model_registry(registry, model)
+    return _memo_on_child(child, "_kdlt_sched_lane", _mint_lane_metrics)
+
+
+def _mint_lane_metrics(child: "Registry") -> dict:
     return {
-        stage: registry.histogram(
-            f"kdlt_pipeline_{stage}_seconds", help, buckets=PIPELINE_STAGE_BUCKETS
-        )
-        for stage, help in PIPELINE_STAGES
+        "batch_size": child.histogram(
+            "kdlt_batcher_batch_size",
+            "dispatched batch sizes",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        ),
+        "queue_full": child.counter(
+            "kdlt_batcher_rejected_total",
+            "requests rejected because queue was full",
+        ),
+        "queue_depth": child.gauge(
+            "kdlt_sched_queue_depth", "images queued awaiting dispatch"
+        ),
+        "dispatch": child.counter(
+            "kdlt_sched_dispatch_total", "batches dispatched for this model"
+        ),
+        "floor_boosts": child.counter(
+            "kdlt_sched_floor_boosts_total",
+            "dispatches granted by the weight-floor starvation guard ahead "
+            "of the deadline order",
+        ),
+        "device_seconds": child.counter(
+            "kdlt_sched_device_seconds_total",
+            "observed dispatch->completion device time consumed by this "
+            "model (the share the weighted policy arbitrates)",
+        ),
+        "weight": child.gauge(
+            "kdlt_sched_weight", "configured scheduling weight"
+        ),
     }
 
 

@@ -203,6 +203,46 @@ def test_dry_run_crosshost_ab_echoes_the_pipeline_config():
     assert out["crosshost"]["host_ms"] == 5.0
 
 
+def test_dry_run_multimodel_ab_echoes_the_scheduler_config():
+    # The --multimodel-ab invocation surface (the unified scheduler's
+    # acceptance harness) must round-trip the CLI.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--multimodel-ab", "5", "--dry-run",
+         "--mm-heavy-device-ms", "80", "--mm-light-deadline-ms", "200",
+         "--mm-rate-x", "3"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "multimodel_ab"
+    assert out["multimodel"]["duration_s"] == 5.0
+    assert out["multimodel"]["heavy_device_ms"] == 80.0
+    assert out["multimodel"]["light_deadline_ms"] == 200.0
+    assert out["multimodel"]["rate_x"] == 3.0
+    assert out["multimodel"]["light_rps"] == 40.0
+
+
+@pytest.mark.slow
+def test_multimodel_ab_weighted_beats_fifo_on_worst_model_goodput():
+    """ISSUE 6's acceptance bar (slow: two ~4s open-loop arms with
+    hundreds of client threads): under mixed 2x load the weighted
+    deadline-aware scheduler beats naive FIFO on worst-model in-deadline
+    goodput by >= 1.2x, without degrading the overloaded heavy model."""
+    bench = _bench_module()
+    out, rc = bench.bench_multimodel_ab(duration_s=4.0)
+    assert rc == 0, out
+    assert out["value"] >= 1.2, out
+    arms = out["arms"]
+    w, f = arms["weighted_deadline"], arms["fifo"]
+    assert w["worst_model_goodput_frac"] > f["worst_model_goodput_frac"]
+    # The rescue must come from the doomed backlog, not the heavy model.
+    assert (
+        w["models"]["mm-heavy"]["goodput_frac"]
+        >= 0.8 * f["models"]["mm-heavy"]["goodput_frac"]
+    )
+
+
 @pytest.mark.slow
 def test_crosshost_ab_pipelined_beats_lockstep():
     """The tentpole's acceptance bar on a REAL 2-process fleet (slow:

@@ -35,6 +35,20 @@ def test_lint_accepts_kdlt_fstring_head():
     assert check_metrics.lint_source(src, "fake.py") == []
 
 
+def test_lint_flags_model_label_minted_outside_central_module():
+    src = 'child = reg.with_labels(model=name, version="1")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "with_labels(model=...)" in v and "central" in v
+    # The central module itself is exempt (model_registry lives there)...
+    assert check_metrics.lint_source(
+        src, os.path.join("kubernetes_deep_learning_tpu", "utils", "metrics.py")
+    ) == []
+    # ...and other labels stay free.
+    assert check_metrics.lint_source(
+        'reg.with_labels(tier="gateway")\n', "fake.py"
+    ) == []
+
+
 def test_lint_flags_direct_construction():
     src = (
         "from kubernetes_deep_learning_tpu.utils.metrics import Histogram\n"

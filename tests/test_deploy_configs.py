@@ -356,6 +356,64 @@ def test_deploy_wires_crosshost_pipeline_envs():
     assert len(depths) == 1, f"replicas disagree on the depth: {depths}"
 
 
+def test_multimodel_scheduler_and_default_model_wiring():
+    """Multi-model serving (ISSUE 6): the model tier carries the unified
+    scheduler's policy + per-model weight envs in BOTH deploy targets with
+    values the code accepts, every model-tier replica agrees (the gateway
+    fails over between them -- a replica on a different policy serves a
+    different latency profile), the gateway's default-model env matches
+    between k8s and compose, and the default model's weight is pinned so a
+    second baked-in model cannot silently dilute its share."""
+    from kubernetes_deep_learning_tpu.runtime.scheduler import (
+        SCHED_POLICY_ENV,
+        SCHED_WEIGHTS_ENV,
+        resolve_policy,
+        resolve_weights,
+    )
+    from kubernetes_deep_learning_tpu.serving.gateway import MODEL_ENV
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    container = model_dep["spec"]["template"]["spec"]["containers"][0]
+    k8s_env = {e["name"]: e.get("value", "") for e in container.get("env", [])}
+    assert SCHED_POLICY_ENV in k8s_env, "model tier must pin the policy"
+    assert resolve_policy(k8s_env[SCHED_POLICY_ENV]) == k8s_env[SCHED_POLICY_ENV]
+    assert SCHED_WEIGHTS_ENV in k8s_env
+    k8s_weights = resolve_weights(k8s_env[SCHED_WEIGHTS_ENV])
+    assert k8s_weights, "weights env must parse to at least one entry"
+
+    gw_env = {
+        e["name"]: e.get("value", "")
+        for e in gw_dep["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    default_model = gw_env[MODEL_ENV]
+    assert default_model, "gateway must pin the default model"
+    assert default_model in k8s_weights, (
+        "the default model's scheduling weight must be pinned explicitly"
+    )
+
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    services = compose["services"]
+    assert str(services["gateway"]["environment"][MODEL_ENV]) == default_model, (
+        "k8s and compose must agree on the default model"
+    )
+    replicas = [
+        name for name, svc in services.items()
+        if isinstance(svc.get("build"), dict)
+        and "model-server" in svc["build"].get("dockerfile", "")
+    ]
+    assert len(replicas) >= 2
+    for name in replicas:
+        env = services[name].get("environment", {})
+        assert str(env.get(SCHED_POLICY_ENV)) == k8s_env[SCHED_POLICY_ENV], (
+            f"compose replica {name!r} disagrees with k8s on the policy"
+        )
+        assert resolve_weights(str(env.get(SCHED_WEIGHTS_ENV))) == k8s_weights, (
+            f"compose replica {name!r} disagrees with k8s on the weights"
+        )
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

@@ -1,0 +1,163 @@
+"""ModelRegistry (serving/registry.py): artifact-root scanning, artifact-
+hash identity (byte-identical re-exports adopted without reload), status
+surfaces, and loader/unloader discipline -- unit level, no server, no jax."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from kubernetes_deep_learning_tpu.serving.registry import (
+    ModelRegistry,
+    artifact_hash,
+)
+
+
+class _Served:
+    """Minimal ServedModel stand-in: what the registry actually touches."""
+
+    class _Engine:
+        ready = True
+        buckets = (1, 2)
+
+    class _Spec:
+        family = "xception"
+        labels = ("a", "b")
+
+    class _Artifact:
+        spec = None
+
+    def __init__(self, name, version):
+        self.name = name
+        self.version = version
+        self.artifact_hash = None
+        self.engine = self._Engine()
+        self.artifact = self._Artifact()
+        self.artifact.spec = self._Spec()
+        self.closed = False
+
+
+def _write_version(root, name, version, payload: bytes):
+    d = os.path.join(root, name, str(version))
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "spec.json"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(d, "params.msgpack"), "wb") as f:
+        f.write(b"params:" + payload)
+    return d
+
+
+def _registry(root, log=None):
+    log = log if log is not None else []
+
+    def loader(name, version, directory):
+        log.append(("load", name, version))
+        return _Served(name, version)
+
+    def unloader(served):
+        log.append(("unload", served.name, served.version))
+        served.closed = True
+
+    return ModelRegistry(str(root), loader, unloader), log
+
+
+def test_scans_every_model_and_highest_version(tmp_path):
+    _write_version(tmp_path, "alpha", 1, b"a1")
+    _write_version(tmp_path, "alpha", 3, b"a3")
+    _write_version(tmp_path, "beta", 2, b"b2")
+    reg, log = _registry(tmp_path)
+    assert sorted(reg.poll()) == ["alpha v3", "beta v2"]
+    assert reg.models["alpha"].version == 3
+    assert reg.models["beta"].version == 2
+    assert "alpha" in reg and reg.get("beta") is not None
+    # No change on disk -> no-op poll.
+    assert reg.poll() == []
+    assert [e for e in log if e[0] == "load"] == [
+        ("load", "alpha", 3), ("load", "beta", 2),
+    ]
+
+
+def test_artifact_hash_keys_identity(tmp_path):
+    d1 = _write_version(tmp_path, "m", 1, b"same-bytes")
+    d2 = _write_version(tmp_path, "m", 2, b"same-bytes")
+    d3 = _write_version(tmp_path, "m", 3, b"different")
+    assert artifact_hash(d1) == artifact_hash(d2)
+    assert artifact_hash(d1) != artifact_hash(d3)
+
+
+def test_byte_identical_reexport_adopts_version_without_reload(tmp_path):
+    _write_version(tmp_path, "m", 1, b"weights-v1")
+    reg, log = _registry(tmp_path)
+    reg.poll()
+    served = reg.models["m"]
+    # Version 2 is the same bytes: the registry must adopt the number
+    # without reload/re-warm (the hash, not the dir name, is identity).
+    _write_version(tmp_path, "m", 2, b"weights-v1")
+    assert reg.poll() == []
+    assert reg.models["m"] is served
+    assert served.version == 2  # status reports the adopted version
+    assert [e for e in log if e[0] == "load"] == [("load", "m", 1)]
+    # Version 3 with NEW bytes is a real reload; the old version unloads.
+    _write_version(tmp_path, "m", 3, b"weights-v3")
+    assert reg.poll() == ["m v3"]
+    assert reg.models["m"] is not served
+    assert served.closed
+    assert ("unload", "m", 2) in log
+
+
+def test_broken_loader_keeps_serving_and_retries(tmp_path):
+    _write_version(tmp_path, "m", 1, b"v1")
+    calls = []
+
+    def loader(name, version, directory):
+        calls.append(version)
+        if version == 2:
+            raise RuntimeError("half-written dir")
+        return _Served(name, version)
+
+    reg = ModelRegistry(str(tmp_path), loader)
+    reg.poll()
+    _write_version(tmp_path, "m", 2, b"v2")
+    assert reg.poll() == []  # failed load never takes down the old version
+    assert reg.models["m"].version == 1
+    assert reg.poll() == []  # ...and is retried on the next scan
+    assert calls == [1, 2, 2]
+
+
+def test_declined_loader_is_skipped(tmp_path):
+    _write_version(tmp_path, "mismatch", 1, b"v1")
+    reg = ModelRegistry(str(tmp_path), lambda *a: None)
+    assert reg.poll() == []
+    assert reg.models == {}
+
+
+def test_status_surfaces(tmp_path):
+    _write_version(tmp_path, "m", 1, b"v1")
+    reg, _ = _registry(tmp_path)
+    reg.poll()
+    status = reg.status()
+    assert set(status) == {"m"}
+    st = status["m"]
+    assert st["version"] == 1 and st["ready"] is True
+    assert st["artifact_hash"] == artifact_hash(
+        os.path.join(str(tmp_path), "m", "1")
+    )
+    assert st["buckets"] == [1, 2]
+    assert st["family"] == "xception"
+    assert reg.model_status("m") == st
+    assert reg.model_status("nope") is None
+
+
+def test_single_model_name_errors_are_actionable(tmp_path):
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        _single_model_name,
+    )
+
+    with pytest.raises(ValueError, match="no versioned model"):
+        _single_model_name(str(tmp_path))
+    _write_version(tmp_path, "one", 1, b"x")
+    assert _single_model_name(str(tmp_path)) == ("one",)
+    _write_version(tmp_path, "two", 1, b"y")
+    with pytest.raises(ValueError, match="exactly one model.*multi-model"):
+        _single_model_name(str(tmp_path))

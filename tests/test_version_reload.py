@@ -67,6 +67,73 @@ def test_hot_reload_new_version(reload_spec, tmp_path):
         server.shutdown()
 
 
+def test_reload_one_model_leaves_other_models_untouched(tmp_path):
+    """Registry hot-reload isolation: dropping /models/<name>/<n+1>
+    reloads ONLY that model -- another model's ServedModel object, engine,
+    and IN-FLIGHT requests are unaffected (the scheduling lane survives
+    engine swaps, and swaps happen per model)."""
+    import threading
+    import time
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine, stub_logits
+
+    specs = {}
+    for name in ("rl-a", "rl-b"):
+        specs[name] = register_spec(ModelSpec(
+            name=name, family="xception", input_shape=(32, 32, 3),
+            labels=("x", "y"),
+        ))
+        art.save_artifact(
+            art.version_dir(str(tmp_path), name, 1), specs[name],
+            {"params": {}}, None, {},
+        )
+    # rl-b's simulated device is slow, so a request on it is reliably
+    # IN FLIGHT while rl-a reloads.
+    device_ms = {"rl-a": 1.0, "rl-b": 400.0}
+    server = ModelServer(
+        str(tmp_path), port=0, buckets=(1, 2), max_delay_ms=1.0,
+        host="127.0.0.1",
+        engine_factory=lambda a, **kw: StubEngine(
+            a, async_device=True,
+            device_ms_per_batch=device_ms[a.spec.name], **kw,
+        ),
+    )
+    try:
+        server.warmup()
+        b_before = server.models["rl-b"]
+        img = np.full((1, 32, 32, 3), 7, np.uint8)
+        result: dict = {}
+
+        def inflight_b():
+            result["logits"] = b_before.predict(img)
+
+        t = threading.Thread(target=inflight_b)
+        t.start()
+        time.sleep(0.05)  # the rl-b batch is now on its slow device
+        # Drop rl-a v2 and reload while rl-b's request is in flight.
+        art.save_artifact(
+            art.version_dir(str(tmp_path), "rl-a", 2), specs["rl-a"],
+            {"params": {"v": np.ones(1, np.float32)}}, None, {},
+        )
+        assert server.poll_versions() == ["rl-a v2"]
+        assert server.models["rl-a"].version == 2
+        # rl-b: same ServedModel object, same engine, request completes.
+        assert server.models["rl-b"] is b_before
+        t.join(timeout=10)
+        assert not t.is_alive()
+        np.testing.assert_array_equal(
+            result["logits"], stub_logits(img, 2)
+        )
+        # Metrics: rl-a's v1 series dropped, v2 present; rl-b's v1 intact.
+        page = server.registry.render()
+        assert 'model="rl-a",version="2"' in page
+        assert 'model="rl-a",version="1"' not in page
+        assert 'model="rl-b",version="1"' in page
+    finally:
+        server.shutdown()
+
+
 def test_broken_version_dir_is_skipped(reload_spec, tmp_path):
     root = str(tmp_path)
     export_model(reload_spec, init_variables(reload_spec, seed=1), root, dtype=np.float32)

@@ -19,7 +19,12 @@ Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
   they cannot be audited statically;
 - Counter/Gauge/Histogram must not be instantiated directly outside
   utils/metrics.py (the Registry mint methods are the only sanctioned
-  constructors -- they dedupe, label, and register).
+  constructors -- they dedupe, label, and register);
+- the ``model`` label must be minted centrally: ``.with_labels(model=...)``
+  outside utils/metrics.py is flagged -- modules attach the label through
+  utils.metrics.model_registry / model_version_registry and friends, which
+  is what keeps its cardinality BOUNDED (MODEL_LABEL_CAP + the overflow
+  bucket) no matter what names a caller feeds in.
 """
 
 from __future__ import annotations
@@ -102,6 +107,21 @@ def lint_source(src: str, rel: str) -> list[str]:
             violations.append(
                 f"{rel}:{node.lineno}: direct {cls}(...) construction; mint "
                 "through a Registry / the utils.metrics helpers instead"
+            )
+            continue
+        # The bounded `model` label: with_labels(model=...) may only happen
+        # inside the central module (model_registry and friends); anywhere
+        # else it bypasses the cardinality cap and the memoized dedupe.
+        if (
+            not is_metrics_module
+            and isinstance(fn, ast.Attribute)
+            and fn.attr == "with_labels"
+            and any(kw.arg == "model" for kw in node.keywords)
+        ):
+            violations.append(
+                f"{rel}:{node.lineno}: .with_labels(model=...) outside "
+                "utils/metrics.py; mint the model label through the central "
+                "helpers (model_registry / model_version_registry)"
             )
             continue
         # Mint calls: .counter / .gauge / .histogram on anything (in this
