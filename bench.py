@@ -221,44 +221,15 @@ def compose_headline(model, dtype, params_dtype, results, faults, flops_img,
     return out, 0 if (valid_pool and headline_batch in eligible) else 1
 
 
-# Per-chip dense peak (TFLOP/s) for the compute dtype, keyed by substrings of
-# jax's Device.device_kind.  Used only to compute the MFU sanity figure; an
-# unknown device reports mfu as null rather than guessing.
-PEAK_TFLOPS_BY_KIND = {
-    "v5 lite": {"bfloat16": 197.0, "float32": 98.5},   # v5e datasheet
-    "v5e": {"bfloat16": 197.0, "float32": 98.5},
-    "v5p": {"bfloat16": 459.0, "float32": 229.5},
-    "v4": {"bfloat16": 275.0, "float32": 137.5},
-    "v6 lite": {"bfloat16": 918.0, "float32": 459.0},  # Trillium
-    "v6e": {"bfloat16": 918.0, "float32": 459.0},
-}
-
-
-def peak_tflops(device, dtype_name: str) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peaks in PEAK_TFLOPS_BY_KIND.items():
-        if sub in kind:
-            return peaks[dtype_name]
-    return None
-
-
-def compiled_flops_per_image(jitted, batch: int, *example_args) -> float | None:
-    """FLOPs/image of the compiled forward, from XLA's own cost analysis.
-
-    IMPORTANT: run this on the NON-fused (flax) forward -- XLA's cost
-    analysis does not see inside Pallas custom calls, so the fused fast
-    path under-reports (7.5 vs ~17 GFLOPs/img) and would overstate MFU's
-    denominator honesty check.
-    """
-    try:
-        ca = jitted.lower(*example_args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-        return flops / batch if flops > 0 else None
-    except Exception as e:  # noqa: BLE001 - cost analysis is best-effort
-        log(f"cost analysis unavailable: {e!r}")
-        return None
+# Device peaks + FLOP counting now live in the runtime (runtime/flops.py)
+# so serving pods maintain the same MFU arithmetic as LIVE gauges
+# (kdlt_mfu_pct{model,bucket}); the bench keeps these names as its offline
+# reference implementation -- the acceptance check is that the two agree.
+from kubernetes_deep_learning_tpu.runtime.flops import (  # noqa: E402
+    PEAK_TFLOPS_BY_KIND,
+    compiled_flops_per_image,
+    peak_tflops,
+)
 
 
 def trace_span_stats(fwd_jit, variables, x, k):
@@ -1544,6 +1515,17 @@ def bench_overload_ab(duration_s=8.0, device_ms=100.0, deadline_ms=600.0,
         end_by = t_base + duration_s + max(2.0, 4 * deadline_s)
         for t in threads:
             t.join(timeout=max(0.0, end_by - time.monotonic()))
+        # Server-side SLO view (utils.slo), fetched before shutdown: the
+        # acceptance cross-check that /debug/slo's goodput/burn agrees with
+        # this arm's client-side ground truth.  Reported, never gating.
+        slo_view = None
+        try:
+            slo = session.get(
+                f"http://127.0.0.1:{server.port}/debug/slo", timeout=5.0
+            ).json()
+            slo_view = (slo.get("models") or {}).get(spec.name)
+        except Exception:  # noqa: BLE001 - diagnostics only
+            pass
         server.shutdown()
         for t in threads:
             t.join(timeout=10.0)
@@ -1569,6 +1551,7 @@ def bench_overload_ab(duration_s=8.0, device_ms=100.0, deadline_ms=600.0,
                 round(float(np.percentile(ok_lat, 99)) * 1e3, 1)
                 if ok_lat else float("inf")
             ),
+            "slo_view": slo_view,
         }
         log(
             f"  admission={'on ' if admission_on else 'off'}: "
@@ -1772,6 +1755,178 @@ def bench_multimodel_ab(duration_s=6.0, heavy_device_ms=120.0,
         "unit": "x worst-model in-deadline goodput (weighted / fifo)",
         "vs_baseline": round(ratio, 2),
         "arms": {"weighted_deadline": arm_weighted, "fifo": arm_fifo},
+    }
+    return out, 0 if ok else 1
+
+
+def bench_obs_overhead_ab(duration_s=5.0, device_ms=0.0, clients=16,
+                          buckets=(1, 2, 4, 8), deadline_ms=2000.0,
+                          rounds=2):
+    """Observability-overhead A/B: the full layer ON vs OFF, ≤2% tax.
+
+    The always-on observability stack -- span tracing with tail-based
+    retention, per-model SLO windows (utils.slo), per-model admission/
+    pipeline series, OpenMetrics exemplars -- rides the request hot path,
+    so its cost must be proven, not assumed.  Both arms run the REAL
+    ModelServer over an instantaneous StubEngine (device_ms=0 by default:
+    the tier is host-path-bound, so any observability cost shows at full
+    strength instead of hiding under device time) with ``clients``
+    closed-loop threads hammering single-image predicts for ``duration_s``.
+    The ON arm enables the SLO engine and exemplars and scrapes /metrics +
+    /debug/slo once a second (scrape load is part of the layer); the OFF
+    arm disables them.  Each arm runs ``rounds`` times interleaved and the
+    best round counts (closed-loop HTTP throughput on a shared host is
+    noisy; the best round is the arm's honest capability).
+
+    rc=0 iff img/s(on) >= 0.98 x img/s(off) AND the on arm demonstrably
+    engaged the layer (exemplars on /metrics, the model on /debug/slo) --
+    so the A/B cannot rot into comparing off against off.
+    """
+    import tempfile
+    import threading
+
+    import requests
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    spec = register_spec(
+        ModelSpec(
+            name="obs-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    buckets = tuple(sorted(buckets))
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 256, size=(1, *spec.input_shape), dtype=np.uint8)
+    body = protocol.encode_predict_request(img)
+    log(
+        f"obs-overhead A/B: {clients} closed-loop clients x {duration_s}s "
+        f"x {rounds} rounds/arm, stub device {device_ms}ms/batch, "
+        f"buckets {buckets}"
+    )
+
+    def run_round(obs_on: bool) -> dict:
+        root = tempfile.mkdtemp(prefix="kdlt-obs-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        prev_ex = os.environ.get(metrics_lib.EXEMPLARS_ENV)
+        os.environ[metrics_lib.EXEMPLARS_ENV] = "1" if obs_on else "0"
+        try:
+            server = ModelServer(
+                root, port=0, buckets=buckets, host="127.0.0.1",
+                batcher_impl="python",
+                engine_factory=lambda a, **kw: StubEngine(
+                    a, device_ms_per_batch=device_ms, async_device=True, **kw
+                ),
+                admission=True,
+                slo=obs_on,
+            )
+            server.warmup()
+            server.start()
+            url = f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict"
+            base = f"http://127.0.0.1:{server.port}"
+            headers = {
+                "Content-Type": protocol.MSGPACK_CONTENT_TYPE,
+                DEADLINE_HEADER: f"{deadline_ms:.1f}",
+            }
+            stop_at = time.monotonic() + duration_s
+            counts = [0] * clients
+            has_exemplars = [False]
+            slo_engaged = [False]
+
+            def hammer(i: int) -> None:
+                session = requests.Session()
+                while time.monotonic() < stop_at:
+                    try:
+                        r = session.post(
+                            url, data=body, headers=headers, timeout=10.0
+                        )
+                        if r.status_code == 200:
+                            counts[i] += 1
+                    except Exception:
+                        pass
+
+            def scrape() -> None:
+                session = requests.Session()
+                while time.monotonic() < stop_at:
+                    try:
+                        page = session.get(f"{base}/metrics", timeout=5.0).text
+                        slo = session.get(f"{base}/debug/slo", timeout=5.0).json()
+                        if "# {trace_id=" in page:
+                            has_exemplars[0] = True
+                        if spec.name in (slo.get("models") or {}):
+                            slo_engaged[0] = True
+                    except Exception:
+                        pass
+                    time.sleep(1.0)
+
+            threads = [
+                threading.Thread(target=hammer, args=(i,), daemon=True)
+                for i in range(clients)
+            ]
+            threads.append(threading.Thread(target=scrape, daemon=True))
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=duration_s + 15.0)
+            elapsed = max(time.monotonic() - t0, 1e-9)
+            server.shutdown()
+            return {
+                "img_per_s": round(sum(counts) / elapsed, 1),
+                "completed": sum(counts),
+                "has_exemplars": has_exemplars[0],
+                "slo_engaged": slo_engaged[0],
+            }
+        finally:
+            if prev_ex is None:
+                os.environ.pop(metrics_lib.EXEMPLARS_ENV, None)
+            else:
+                os.environ[metrics_lib.EXEMPLARS_ENV] = prev_ex
+
+    arms: dict[str, list[dict]] = {"on": [], "off": []}
+    for _ in range(max(1, int(rounds))):
+        for name, flag in (("off", False), ("on", True)):  # interleaved
+            r = run_round(flag)
+            arms[name].append(r)
+            log(
+                f"  obs={name:3s}: {r['img_per_s']:8.1f} img/s "
+                f"({r['completed']} completed"
+                + (
+                    f", exemplars={r['has_exemplars']}, "
+                    f"slo={r['slo_engaged']})" if name == "on" else ")"
+                )
+            )
+    best_on = max(r["img_per_s"] for r in arms["on"])
+    best_off = max(r["img_per_s"] for r in arms["off"])
+    engaged = any(
+        r["has_exemplars"] and r["slo_engaged"] for r in arms["on"]
+    )
+    ratio = best_on / max(best_off, 1e-9)
+    ok = ratio >= 0.98 and engaged
+    out = {
+        "metric": (
+            f"observability-overhead A/B (stub tier, {clients} closed-loop "
+            f"clients, best of {rounds} interleaved rounds/arm): img/s with "
+            "the full layer (SLO windows + exemplars + retention) on vs off"
+        ),
+        "value": round(ratio, 4),
+        "unit": "x img/s (observability on / off)",
+        "vs_baseline": round(ratio, 4),
+        "layer_engaged": engaged,
+        "arms": {
+            "on": {"best_img_per_s": best_on, "rounds": arms["on"]},
+            "off": {"best_img_per_s": best_off, "rounds": arms["off"]},
+        },
     }
     return out, 0 if ok else 1
 
@@ -2616,6 +2771,29 @@ def main() -> int:
         help="simulated device ms per batch for --trace-breakdown",
     )
     p.add_argument(
+        "--obs-overhead-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: observability-overhead A/B -- hammer a "
+             "stub-backed model tier with closed-loop clients for this many "
+             "seconds per round, with the full observability layer (SLO "
+             "windows + exemplars + tail retention) on vs off (no device "
+             "needed; rc=0 iff the on arm holds >= 98%% of the off arm's "
+             "img/s and the layer demonstrably engaged)",
+    )
+    p.add_argument(
+        "--obs-clients", type=int, default=16,
+        help="closed-loop client threads for --obs-overhead-ab",
+    )
+    p.add_argument(
+        "--obs-device-ms", type=float, default=0.0,
+        help="simulated device ms per batch for --obs-overhead-ab (0 = "
+             "instantaneous stub: host-path-bound, overhead shows at full "
+             "strength)",
+    )
+    p.add_argument(
+        "--obs-rounds", type=int, default=2,
+        help="interleaved rounds per arm for --obs-overhead-ab (best counts)",
+    )
+    p.add_argument(
         "--dry-run", action="store_true",
         help="parse arguments, echo the resolved run configuration as one "
              "JSON line, and exit 0 -- a CI smoke so bench refactors can "
@@ -2666,7 +2844,8 @@ def main() -> int:
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
                      "batcher_sweep", "host_saturation", "overload_ab",
-                     "chaos_ab", "trace_breakdown", "multimodel_ab"):
+                     "chaos_ab", "trace_breakdown", "multimodel_ab",
+                     "obs_overhead_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -2699,6 +2878,12 @@ def main() -> int:
             "trace": {
                 "requests": args.trace_breakdown,
                 "device_ms": args.trace_device_ms,
+            },
+            "obs_overhead": {
+                "duration_s": args.obs_overhead_ab,
+                "clients": args.obs_clients,
+                "device_ms": args.obs_device_ms,
+                "rounds": args.obs_rounds,
             },
             "multimodel": {
                 "duration_s": args.multimodel_ab,
@@ -2795,6 +2980,16 @@ def main() -> int:
             light_deadline_ms=args.mm_light_deadline_ms,
             rate_x=args.mm_rate_x,
             light_rps=args.mm_light_rps,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.obs_overhead_ab > 0:
+        out, rc = bench_obs_overhead_ab(
+            duration_s=args.obs_overhead_ab,
+            device_ms=args.obs_device_ms,
+            clients=args.obs_clients,
+            rounds=args.obs_rounds,
         )
         print(json.dumps(out), flush=True)
         return rc

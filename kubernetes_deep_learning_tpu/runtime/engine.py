@@ -35,6 +35,7 @@ from typing import Any, Sequence
 import numpy as np
 
 from kubernetes_deep_learning_tpu.export.artifact import ModelArtifact
+from kubernetes_deep_learning_tpu.runtime import flops as flops_lib
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
@@ -702,6 +703,17 @@ class InferenceEngine:
             "kdlt_engine_fast_degraded",
             "1 when a fused fast-path compile failure forced the exact graph",
         )
+        # Live device-time attribution (runtime.flops): per-bucket MFU +
+        # device-busy gauges from the same dispatch->sync timings as
+        # kdlt_engine_infer_seconds.  FLOPs per bucket are estimated on a
+        # background thread (lowering-only cost analysis, no compile); the
+        # registry already carries this engine's model/version labels, so
+        # the gauges read kdlt_mfu_pct{model,version,bucket} on /metrics.
+        self._mfu = flops_lib.MfuAccountant(
+            registry,
+            flops_lib.peak_tflops(self._device, str(self._compute_dtype)),
+            self._flops_per_image,
+        )
 
     @property
     def ready(self) -> bool:
@@ -847,6 +859,39 @@ class InferenceEngine:
 
         return forward
 
+    def _flops_per_image(self, bucket: int) -> float | None:
+        """FLOPs/image at one bucket shape, for the live MFU gauges.
+
+        Runs on the MfuAccountant's background thread.  Uses the NON-fused
+        flax graph (bench.py's rule: cost analysis cannot see inside Pallas
+        custom calls) and the LOWERING-level analysis -- trace only, never
+        an XLA compile, so attribution can never cost a serving pod compile
+        time.  Families with no in-tree model (exported-only artifacts)
+        raise inside and report None: their gauge simply doesn't exist.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from kubernetes_deep_learning_tpu.models import build_forward
+
+        base = build_forward(
+            self.spec, dtype=jnp.dtype(self._compute_dtype), fast=False
+        )
+        if self._quantization is not None and self.mesh is None:
+            from kubernetes_deep_learning_tpu.ops.quantize import (
+                dequantize_variables,
+            )
+
+            exact = base
+
+            def base(variables, images):  # noqa: F811 - wrapped exact forward
+                return exact(dequantize_variables(variables), images)
+
+        x = np.zeros((bucket, *self.spec.input_shape), np.uint8)
+        return flops_lib.lowered_flops_per_image(
+            jax.jit(base), bucket, self._variables, x
+        )
+
     def _f32_forward(self):
         """Lazily build the float32 debug-path fn (exported artifacts only)."""
         if self._jitted_f32 is None:
@@ -912,7 +957,9 @@ class InferenceEngine:
         self._m_infer_latency.observe(seconds)
         self._m_images.inc(n)
         self._m_batches.inc()
-        self._m_pad_waste.inc(self.bucket_for(n) - n)
+        bucket = self.bucket_for(n)
+        self._m_pad_waste.inc(bucket - n)
+        self._mfu.observe(bucket, n, seconds)
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """uint8 (N,H,W,C) -> float32 logits (N,num_classes); pads to bucket."""

@@ -440,10 +440,15 @@ class UnifiedScheduler:
                 lane = self._choose(ready, now)
                 units: list[_Unit] = []
                 total = 0
+                taken_at = time.monotonic()
                 while lane.queue and total + lane.queue[0].n <= lane.max_batch:
                     unit = lane.queue.pop(0)
                     units.append(unit)
                     total += unit.n
+                    # Queue age at scheduling: the cross-model arbitration
+                    # delay an autoscaler/operator reads per lane
+                    # (kdlt_sched_queue_age_seconds{model=...}).
+                    lane.m["queue_age"].observe(max(0.0, taken_at - unit.enq_t))
                 lane.pending_images -= total
                 lane.m["queue_depth"].set(float(lane.pending_images))
                 return lane, units, total

@@ -139,6 +139,46 @@ def fetch_trace(gateway_url: str, rid: str, timeout: float = 5.0) -> list[dict]:
     return r.json()["spans"]
 
 
+def fetch_slo(gateway_url: str, timeout: float = 5.0) -> dict:
+    """GET the gateway's merged /debug/slo view (its own client-observed
+    accounting plus every model-tier replica's, summed per model)."""
+    import requests
+
+    r = requests.get(f"{gateway_url}/debug/slo", timeout=timeout)
+    r.raise_for_status()
+    return r.json()
+
+
+def render_slo(payload: dict) -> str:
+    """ASCII rendering of a /debug/slo payload: one row per (view, model,
+    window), burn rate front and center."""
+    if not payload.get("enabled", False):
+        return "SLO engine disabled on this tier (KDLT_SLO=0 / --no-slo)"
+    target = payload.get("target")
+    lines = [
+        f"SLO target {target:.4g} (tier {payload.get('tier', '?')}; "
+        f"burn 1.0 = sustainable, >1 = eating error budget)"
+    ]
+    header = (
+        f"{'view':<10s} {'model':<24s} {'win':<4s} {'requests':>8s} "
+        f"{'goodput':>8s} {'burn':>8s} {'shed%':>7s} {'err%':>7s}"
+    )
+    lines.append(header)
+    for view in ("gateway", "merged"):
+        models = payload.get(view) or {}
+        for model in sorted(models):
+            for window, row in models[model].items():
+                counted = row.get("total", 0) - row.get("client", 0)
+                lines.append(
+                    f"{view:<10s} {model:<24s} {window:<4s} {counted:>8d} "
+                    f"{row.get('goodput_ratio', 0.0):>8.4f} "
+                    f"{row.get('burn_rate', 0.0):>8.2f} "
+                    f"{row.get('shed_ratio', 0.0) * 100:>6.2f}% "
+                    f"{row.get('error_ratio', 0.0) * 100:>6.2f}%"
+                )
+    return "\n".join(lines)
+
+
 def predict_images(
     server_url: str, model: str, images: np.ndarray, timeout: float = 30.0
 ) -> tuple[np.ndarray, list[str]]:
@@ -180,7 +220,16 @@ def main(argv: list[str] | None = None) -> int:
         "gateway (which merges the model tier's spans in) and render the "
         "request's cross-tier span waterfall",
     )
+    p.add_argument(
+        "--slo", action="store_true",
+        help="INSTEAD of predicting: fetch the gateway's /debug/slo (its "
+        "client-observed view merged with every model-tier replica's) and "
+        "render per-model goodput + 5m/1h burn rates",
+    )
     args = p.parse_args(argv)
+    if args.slo:
+        print(render_slo(fetch_slo(args.gateway)))
+        return 0
     stats: dict = {}
     scores = predict_url(
         args.gateway, args.image_url,

@@ -59,6 +59,7 @@ from kubernetes_deep_learning_tpu.serving.tracing import (
 )
 from kubernetes_deep_learning_tpu.serving.upstream import UpstreamPool, parse_hosts
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import slo as slo_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 DEFAULT_PORT = 9696          # reference gateway port (gateway.dockerfile:15-16)
@@ -121,6 +122,7 @@ class Gateway:
         failover: bool | None = None,
         hedge_delay_ms: float | None = None,
         probe_interval_s: float | None = None,
+        slo: bool | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -155,7 +157,12 @@ class Gateway:
         # cross-tier waterfall.  /debug/trace/<rid> on this tier MERGES the
         # model tier's spans in (fetched from the replica pool), so one GET
         # yields the full client-visible timeline.
-        self.tracer = trace_lib.Tracer("gateway")
+        self.tracer = trace_lib.Tracer("gateway", registry=self.registry)
+        # SLO engine (utils.slo): the CLIENT-OBSERVED per-model goodput/
+        # burn-rate windows -- this tier sees what the user saw (including
+        # failover/hedging saves the model tier's own view cannot know
+        # about).  /debug/slo here also merges every replica's view.
+        self.slo = slo_lib.SloEngine(self.registry, tier="gateway", enabled=slo)
         self._m_requests = self.registry.counter("kdlt_gateway_requests_total", "requests")
         self._m_errors = self.registry.counter("kdlt_gateway_errors_total", "errors")
         self._m_latency = self.registry.histogram(
@@ -855,10 +862,49 @@ class Gateway:
             except Exception as e:
                 return 503, str(e).encode(), "text/plain"
         if path == "/metrics":
+            # Pull-model freshness: SLO window gauges recompute at scrape.
+            self.slo.refresh()
             return 200, self.registry.render().encode(), "text/plain"
+        if path == "/debug/slo":
+            return (
+                200, json.dumps(self.handle_slo()).encode(), "application/json"
+            )
         if path.startswith("/debug/trace/"):
             return self.handle_trace(path.rsplit("/", 1)[-1])
         return 404, b'{"error": "not found"}', "application/json"
+
+    def handle_slo(self) -> dict:
+        """GET /debug/slo: the MERGED fleet SLO view.
+
+        Three sections: ``gateway`` is this tier's own accounting (what
+        clients experienced, failover/hedging included), ``replicas`` is
+        each model-tier replica's /debug/slo verbatim, and ``merged`` sums
+        the replicas' raw counts per (model, window) and re-derives
+        goodput/burn -- the per-model fleet truth an autoscaler reads.  An
+        unreachable replica degrades to an error entry, never a failed
+        response: like /debug/trace, this surface must work best when the
+        serving path is misbehaving.
+        """
+        payload = self.slo.debug_payload()
+        payload["gateway"] = payload.pop("models", {})
+        replicas: dict[str, dict] = {}
+        for replica in self.pool.replicas:
+            try:
+                r = self._session().get(
+                    f"{replica.base}/debug/slo", timeout=2.0
+                )
+                replicas[replica.host] = (
+                    r.json() if r.status_code == 200
+                    else {"error": f"status {r.status_code}"}
+                )
+            except Exception as e:  # noqa: BLE001 - partial views beat none
+                replicas[replica.host] = {"error": str(e)[:200]}
+        payload["replicas"] = replicas
+        payload["merged"] = slo_lib.merge_model_views(
+            [v.get("models") for v in replicas.values() if isinstance(v, dict)],
+            self.slo.target,
+        )
+        return payload
 
     def handle_trace(self, raw_rid: str) -> tuple[int, bytes, str]:
         """GET /debug/trace/<rid>: the MERGED cross-tier waterfall.
@@ -871,22 +917,32 @@ class Gateway:
         when the serving path is misbehaving.
         """
         rid = ensure_request_id(raw_rid)
-        spans = self.tracer.spans(rid) or []
+        info = self.tracer.trace_info(rid)
+        spans = list(info["spans"]) if info is not None else []
+        # Truncation accounting rides along: a merged waterfall missing its
+        # pipeline stages with spans_dropped > 0 was CAPPED, not
+        # un-instrumented (the silent-drop bug this field fixes).
+        spans_dropped = info["spans_dropped"] if info is not None else 0
+        retention = info["retention_class"] if info is not None else None
         for replica in self.pool.replicas:
             try:
                 r = self._session().get(
                     f"{replica.base}/debug/trace/{rid}", timeout=2.0
                 )
                 if r.status_code == 200:
-                    spans.extend(r.json().get("spans", []))
+                    body = r.json()
+                    spans.extend(body.get("spans", []))
+                    spans_dropped += int(body.get("spans_dropped", 0) or 0)
             except Exception:  # noqa: BLE001 - partial traces beat no traces
                 continue
         if not spans:
             return 404, json.dumps(
-                {"error": f"no trace for {rid!r} on any tier"}
+                {"error": f"no trace for {rid!r} on any tier",
+                 "ring": self.tracer.stats()}
             ).encode(), "application/json"
         return 200, json.dumps(
-            {"trace_id": rid, "spans": trace_lib.sort_spans(spans)}
+            {"trace_id": rid, "spans": trace_lib.sort_spans(spans),
+             "spans_dropped": spans_dropped, "retention_class": retention}
         ).encode(), "application/json"
 
     def reject_oversize(self, length: int) -> tuple[int, bytes, str] | None:
@@ -1005,13 +1061,30 @@ class Gateway:
         finally:
             if ticket is not None:
                 ticket.release()
-            self._m_latency.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            slow = (
+                self._m_latency.count >= 100
+                and dt >= self._m_latency.percentile(0.99)
+            )
+            self._m_latency.observe(
+                dt,
+                exemplar=rid if metrics_lib.exemplars_enabled() else None,
+            )
+            deadline_exceeded = deadline is not None and deadline.expired
+            # Client-observed SLO accounting, per routed model -- the same
+            # boundary as kdlt_gateway_request_seconds.
+            self.slo.record(
+                routed, status, dt, deadline_exceeded=deadline_exceeded
+            )
             # Root span last (it covers the whole handler); the transports
             # build the X-Kdlt-Trace header AFTER handle_predict returns,
             # so the header summary includes it.
             self.tracer.record(
                 rid, "gateway.request", w_start, trace_lib.now_s() - w_start,
                 span_id=rt.span_id, status=status, urls=n_urls,
+            )
+            self.tracer.classify(
+                rid, trace_lib.retention_class(status, deadline_exceeded, slow)
             )
             # Sheds (503/504) skip the always-log rule: rejection must stay
             # cheap under overload; kdlt_admission_shed_total counts them.
@@ -1175,6 +1248,12 @@ def main(argv: list[str] | None = None) -> int:
         help="seconds between /healthz probes of unhealthy upstream "
         "replicas (default $KDLT_PROBE_INTERVAL_S or 1.0)",
     )
+    p.add_argument(
+        "--no-slo",
+        action="store_true",
+        help="disable the SLO engine (per-model goodput/burn-rate windows, "
+        "kdlt_slo_* gauges, /debug/slo); default $KDLT_SLO or enabled",
+    )
     args = p.parse_args(argv)
     gw = Gateway(
         serving_host=args.serving_host,
@@ -1187,6 +1266,7 @@ def main(argv: list[str] | None = None) -> int:
         failover=False if args.no_failover else None,
         hedge_delay_ms=args.hedge_delay_ms,
         probe_interval_s=args.probe_interval_s,
+        slo=False if args.no_slo else None,
     )
     # SIGTERM -> flip /readyz, shed new work, finish in-flight, then stop;
     # pairs with the k8s terminationGracePeriodSeconds/preStop settings.

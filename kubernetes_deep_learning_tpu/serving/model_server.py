@@ -77,6 +77,7 @@ from kubernetes_deep_learning_tpu.serving.tracing import (
     log_request,
 )
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import slo as slo_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
@@ -338,6 +339,7 @@ class ModelServer:
         admission: bool | None = None,
         sched_policy: str | None = None,
         sched_weights: dict[str, float] | None = None,
+        slo: bool | None = None,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -364,8 +366,16 @@ class ModelServer:
         self.registry = metrics_lib.Registry()
         # Per-request span traces (utils.trace): the model-tier half of the
         # cross-tier waterfall, keyed by the propagated X-Request-Id and
-        # served at /debug/trace/<rid>.
-        self.tracer = trace_lib.Tracer("model-server")
+        # served at /debug/trace/<rid>.  The registry wires the tail-based
+        # retention accounting (kdlt_trace_{retained,dropped}_total).
+        self.tracer = trace_lib.Tracer("model-server", registry=self.registry)
+        # SLO engine (utils.slo): per-model sliding-window goodput and
+        # multi-window burn rates against $KDLT_SLO_TARGET, fed from the
+        # same handler boundary as kdlt_server_request_seconds; serves
+        # /debug/slo and the kdlt_slo_* gauges.  slo=None -> $KDLT_SLO ->
+        # enabled.
+        self.slo = slo_lib.SloEngine(self.registry, tier="model-server",
+                                     enabled=slo)
         # Fault injection (serving.faults): the server.predict point; None
         # (zero-overhead) unless $KDLT_FAULTS configures rules.
         self._faults = faults_lib.from_env()
@@ -661,18 +671,26 @@ class ModelServer:
                         return self._send(200, b"ready", "text/plain")
                     return self._send(503, b"warming up", "text/plain")
                 if self.path == "/metrics":
+                    # Pull-model freshness: the SLO window gauges are
+                    # recomputed at scrape time, not on a timer.
+                    server.slo.refresh()
                     return self._send(200, server.registry.render().encode(), "text/plain")
+                if self.path == "/debug/slo":
+                    return self._send_json(200, server.slo.debug_payload())
                 if self.path.startswith("/debug/trace/"):
                     rid = ensure_request_id(self.path.rsplit("/", 1)[-1])
-                    spans = server.tracer.spans(rid)
-                    if spans is None:
+                    info = server.tracer.trace_info(rid)
+                    if info is None:
+                        # Ring accounting on the 404: "evicted" and "never
+                        # instrumented" are different debugging paths.
                         return self._send_json(
                             404, {"error": f"no trace for {rid!r} (evicted "
-                                  "from the ring buffer or never seen)"}
+                                  "from the ring buffer or never seen)",
+                                  "ring": server.tracer.stats()}
                         )
                     return self._send_json(
                         200,
-                        {"trace_id": rid, "tier": "model-server", "spans": spans},
+                        {"trace_id": rid, "tier": "model-server", **info},
                     )
                 if self.path.split("?", 1)[0] == "/debug/profile":
                     # GET /debug/profile?seconds=N: the curl-friendly form
@@ -873,7 +891,29 @@ class ModelServer:
                     self._discard_body()
                     if ticket is not None:
                         ticket.release()
-                    server._m_latency.observe(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    # "slow" for trace retention = past the tier's own p99,
+                    # judged against the distribution BEFORE this sample and
+                    # only once it is meaningful.
+                    slow = (
+                        server._m_latency.count >= 100
+                        and dt >= server._m_latency.percentile(0.99)
+                    )
+                    server._m_latency.observe(
+                        dt,
+                        exemplar=(
+                            rid if metrics_lib.exemplars_enabled() else None
+                        ),
+                    )
+                    deadline_exceeded = (
+                        deadline is not None and deadline.expired
+                    )
+                    # SLO accounting at the same boundary as the latency
+                    # histogram, so /debug/slo reconciles against /metrics.
+                    server.slo.record(
+                        m.group(1), status, dt,
+                        deadline_exceeded=deadline_exceeded,
+                    )
                     # Root span last: it closes after the response went out,
                     # which is why the X-Kdlt-Trace header carries only the
                     # sub-spans while /debug/trace/<rid> has everything.
@@ -882,6 +922,14 @@ class ModelServer:
                         trace_lib.now_s() - w_start,
                         parent_id=parent, span_id=rt.span_id,
                         status=status, batch=batch,
+                    )
+                    # Tail-based retention: errors/sheds/deadline misses/
+                    # slowest-percentile traces outlive routine ones.
+                    server.tracer.classify(
+                        rid,
+                        trace_lib.retention_class(
+                            status, deadline_exceeded, slow
+                        ),
                     )
                     # Sheds (503/504) are excluded from the always-log rule:
                     # rejection must stay cheap under overload (a log line
@@ -1227,6 +1275,12 @@ def main(argv: list[str] | None = None) -> int:
         "concurrency limiting); graceful drain stays on",
     )
     p.add_argument(
+        "--no-slo",
+        action="store_true",
+        help="disable the SLO engine (per-model goodput/burn-rate windows, "
+        "kdlt_slo_* gauges, /debug/slo); default $KDLT_SLO or enabled",
+    )
+    p.add_argument(
         "--compile-cache-dir",
         default="",
         help="persistent XLA compilation-cache directory; '' enables it only "
@@ -1298,6 +1352,7 @@ def main(argv: list[str] | None = None) -> int:
             None if args.sched_weights is None
             else resolve_weights(args.sched_weights)
         ),
+        slo=False if args.no_slo else None,
     )
     # SIGTERM -> flip /readyz, stop admission, let in-flight batches finish,
     # then stop; fits inside the k8s terminationGracePeriodSeconds budget.

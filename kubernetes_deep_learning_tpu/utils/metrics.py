@@ -8,7 +8,9 @@ one of these registries, which also feeds bench.py's latency percentiles.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
+import time
 
 # Default latency buckets in seconds (sub-ms to 20 s, the reference's
 # implicit deadline ceiling, reference model_server.py:55).
@@ -221,7 +223,132 @@ def _mint_lane_metrics(child: "Registry") -> dict:
         "weight": child.gauge(
             "kdlt_sched_weight", "configured scheduling weight"
         ),
+        "queue_age": child.histogram(
+            "kdlt_sched_queue_age_seconds",
+            "age of queued units when their dispatch plan was taken "
+            "(enqueue -> scheduled): the queuing-delay component of "
+            "cross-model arbitration",
+            buckets=PIPELINE_STAGE_BUCKETS,
+        ),
     }
+
+
+# --- SLO engine series (utils.slo) -----------------------------------------
+#
+# kdlt_slo_* is the second observability layer on top of the admission/
+# pipeline substrate: per-model sliding-window goodput and multi-window burn
+# rates against $KDLT_SLO_TARGET.  Minted HERE and nowhere else
+# (tools/check_metrics.py rejects kdlt_slo_ mints outside this module): the
+# ``model`` label stays bounded through model_registry, and the ``window``
+# label's value set is exactly utils.slo.WINDOWS.
+
+def slo_tier_metrics(registry: "Registry") -> dict:
+    """The per-tier SLO statics: the configured objective itself."""
+    return {
+        "target": registry.gauge(
+            "kdlt_slo_target",
+            "configured SLO target (KDLT_SLO_TARGET): the fraction of "
+            "requests that must complete in-deadline",
+        ),
+    }
+
+
+def slo_model_window_metrics(
+    registry: "Registry", model: str, window: str
+) -> dict:
+    """One (model, window) cell of the SLO engine's gauge matrix.
+
+    Memoized per (model child, window) like the other model-labeled
+    helpers; ``window`` values come from utils.slo.WINDOWS (e.g. "5m",
+    "1h"), so both labels are bounded by construction.
+    """
+    child = model_registry(registry, model)
+
+    def mint(c: "Registry") -> dict:
+        w = c.with_labels(window=window)
+        return {
+            "goodput_ratio": w.gauge(
+                "kdlt_slo_goodput_ratio",
+                "fraction of SLO-eligible requests completed in-deadline "
+                "over the window",
+            ),
+            "burn_rate": w.gauge(
+                "kdlt_slo_burn_rate",
+                "error-budget burn rate over the window (bad fraction / "
+                "(1 - target)); 1.0 = burning exactly at the sustainable rate",
+            ),
+            "shed_ratio": w.gauge(
+                "kdlt_slo_shed_ratio",
+                "fraction of SLO-eligible requests shed (503/504) over the "
+                "window",
+            ),
+            "error_ratio": w.gauge(
+                "kdlt_slo_error_ratio",
+                "fraction of SLO-eligible requests failed server-side over "
+                "the window",
+            ),
+            "requests": w.gauge(
+                "kdlt_slo_window_requests",
+                "SLO-eligible requests observed in the window",
+            ),
+        }
+
+    return _memo_on_child(child, f"_kdlt_slo_{window}", mint)
+
+
+# Tail-based trace retention (utils.trace.Tracer): every finished trace is
+# classified into exactly one of these, and eviction prefers dropping
+# ``routine`` traces first -- the label set is this tuple, nothing else.
+TRACE_RETENTION_CLASSES = (
+    ("error", "the request failed server-side (5xx/disconnect)"),
+    ("shed", "the request was shed (503/504)"),
+    ("deadline", "the request completed but violated its deadline budget"),
+    ("slow", "the request landed in the tier's slowest percentile"),
+    ("routine", "an unremarkable request"),
+)
+
+
+def trace_retention_metrics(registry: "Registry") -> dict:
+    """The tracer's retention accounting: traces classified (retained) and
+    traces evicted from the ring (dropped), by retention class.  A rising
+    dropped{class!="routine"} means interesting traces are being lost --
+    grow the ring or scrape /debug/trace faster."""
+    return {
+        "retained": {
+            cls: registry.with_labels(**{"class": cls}).counter(
+                "kdlt_trace_retained_total",
+                f"traces classified for retention: {help}",
+            )
+            for cls, help in TRACE_RETENTION_CLASSES
+        },
+        "dropped": {
+            cls: registry.with_labels(**{"class": cls}).counter(
+                "kdlt_trace_dropped_total",
+                f"traces evicted from the ring buffer: {help}",
+            )
+            for cls, help in TRACE_RETENTION_CLASSES
+        },
+    }
+
+
+def mfu_bucket_gauge(registry: "Registry", bucket: int) -> "Gauge":
+    """Live per-bucket MFU gauge (runtime.flops.MfuAccountant); the caller's
+    registry carries the model/version labels, ``bucket`` values are the
+    engine's compiled ladder -- bounded by construction."""
+    return registry.with_labels(bucket=str(int(bucket))).gauge(
+        "kdlt_mfu_pct",
+        "live model FLOP/s utilization of the device's dense peak, per "
+        "compiled batch bucket (EWMA over dispatch->sync timings; compare "
+        "with bench.py's offline mfu_pct)",
+    )
+
+
+def device_busy_gauge(registry: "Registry") -> "Gauge":
+    return registry.gauge(
+        "kdlt_device_busy_ratio",
+        "decayed fraction of wall time the device spent executing this "
+        "engine's batches (dispatch->sync timings; ~30 s half-life)",
+    )
 
 
 def crosshost_metrics(registry: "Registry") -> dict:
@@ -370,6 +497,26 @@ def dispatch_stall_counter(registry: "Registry") -> "Counter":
     )
 
 
+# --- OpenMetrics exemplars ---------------------------------------------------
+#
+# Behind $KDLT_METRICS_EXEMPLARS=1 the latency histograms annotate bucket
+# samples with the trace id of a recent observation that landed there
+# (``... # {trace_id="..."} value timestamp``), so a burn-rate spike on a
+# dashboard links DIRECTLY to /debug/trace/<rid> waterfalls of the requests
+# that caused it.  Off (the default) the exposition is byte-identical to the
+# legacy format -- classic Prometheus text-format parsers never see the
+# annotation.  Exemplars exist on histograms ONLY (the OpenMetrics rule);
+# tools/check_metrics.py rejects exemplar= on counter/gauge mutations.
+
+EXEMPLARS_ENV = "KDLT_METRICS_EXEMPLARS"
+
+
+def exemplars_enabled() -> bool:
+    """Read the env gate afresh (cheap: a handful of calls per request);
+    in-process A/B arms flip the env between servers."""
+    return os.environ.get(EXEMPLARS_ENV, "").strip() == "1"
+
+
 def _escape_label_value(v) -> str:
     """Prometheus text-format label escaping: backslash, quote, newline.
     Without it a label value containing '"' or '\\n' desyncs strict
@@ -439,14 +586,21 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket
         self._sum = 0.0
         self._n = 0
+        # Last exemplar per bucket index: (trace_id, value, unix_ts).  Only
+        # ever populated by callers passing exemplar= (the request-latency
+        # observe sites, behind the env gate), so plain histograms pay one
+        # None check.
+        self._exemplars: dict[int, tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._n += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), v, time.time())
 
     def percentile(self, q: float) -> float:
         """Approximate percentile from bucket upper bounds (q in [0,1])."""
@@ -472,17 +626,39 @@ class Histogram:
 
     kind = "histogram"
 
+    def _exemplar_suffix(self, i: int, with_exemplars: bool) -> str:
+        """The OpenMetrics exemplar annotation for bucket index ``i``, or ""
+        (always "" unless the env gate is on, so the legacy exposition is
+        byte-identical with the flag off)."""
+        if not with_exemplars:
+            return ""
+        ex = self._exemplars.get(i)
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return (
+            f' # {{trace_id="{_escape_label_value(trace_id)}"}} '
+            f"{value:.6g} {ts:.3f}"
+        )
+
     def sample_lines(self) -> list[str]:
         out = []
         cum = 0
+        with_ex = bool(self._exemplars) and exemplars_enabled()
         with self._lock:
-            for le, c in zip(self.buckets, self._counts):
+            for i, (le, c) in enumerate(zip(self.buckets, self._counts)):
                 cum += c
                 le_label = f'le="{le}"'
-                out.append(f"{self.name}_bucket{_fmt_labels(self.labels, le_label)} {cum}")
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(self.labels, le_label)} {cum}"
+                    + self._exemplar_suffix(i, with_ex)
+                )
             cum += self._counts[-1]
             inf_label = 'le="+Inf"'
-            out.append(f"{self.name}_bucket{_fmt_labels(self.labels, inf_label)} {cum}")
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(self.labels, inf_label)} {cum}"
+                + self._exemplar_suffix(len(self.buckets), with_ex)
+            )
             out.append(f"{self.name}_sum{_fmt_labels(self.labels)} {self._sum}")
             out.append(f"{self.name}_count{_fmt_labels(self.labels)} {self._n}")
         return out

@@ -37,6 +37,8 @@ import uuid
 from collections import OrderedDict
 from contextlib import contextmanager
 
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
 # Response header: Server-Timing-style per-tier span summary.
 TRACE_HEADER = "X-Kdlt-Trace"
 # Request header: the caller's active span id, which becomes the parent of
@@ -107,22 +109,91 @@ class Span:
         }
 
 
+# Retention classes, most-protected first.  Eviction walks the ring oldest
+# first but skips protected traces while any routine one remains: the
+# traces tail debugging actually needs (errors, sheds, deadline misses, the
+# slowest percentile) outlive the routine churn around them.
+RETENTION_PRIORITY = {
+    "error": 4, "shed": 3, "deadline": 2, "slow": 1, "routine": 0,
+}
+
+
+def retention_class(status: int, deadline_exceeded: bool = False,
+                    slow: bool = False) -> str:
+    """A finished request's retention class from its observable outcome
+    (shared by both tiers so the classes mean the same thing fleet-wide)."""
+    if status in (503, 504):
+        return "shed"
+    if status < 0 or status >= 500:
+        return "error"
+    if status == 200 and deadline_exceeded:
+        return "deadline"
+    if slow:
+        return "slow"
+    return "routine"
+
+
+class _TraceEntry:
+    __slots__ = ("spans", "cls", "dropped_spans")
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self.cls: str | None = None  # None = not yet classified
+        self.dropped_spans = 0
+
+
 class Tracer:
     """Bounded per-tier span buffer: an OrderedDict ring of recent traces.
 
-    Eviction is by TRACE (oldest first-seen trace goes when ``max_traces``
-    is exceeded), and each trace's span list is capped at ``max_spans``
-    (a runaway batch-fan-in cannot balloon one entry).  All methods are
-    thread-safe; record() is O(1) amortized -- cheap enough for the hot
-    path unconditionally, so tracing needs no sampling knob at this scale.
+    Eviction is by TRACE and **tail-biased**: when ``max_traces`` is
+    exceeded, the oldest *routine* (or unclassified) trace goes first;
+    error/shed/deadline-violating/slowest-percentile traces (see
+    :func:`retention_class`, set via :meth:`classify`) are only evicted
+    when nothing routine is left.  Each trace's span list is capped at
+    ``max_spans`` -- excess spans are COUNTED (``dropped_spans``), never
+    silently discarded, so a truncated waterfall is distinguishable from
+    missing instrumentation.  All methods are thread-safe; record() is
+    O(1) amortized -- cheap enough for the hot path unconditionally, so
+    tracing needs no sampling knob at this scale.
+
+    ``registry`` (optional) mints the retention accounting series
+    ``kdlt_trace_{retained,dropped}_total{class=...}``.
     """
 
-    def __init__(self, tier: str, max_traces: int = 512, max_spans: int = 128):
+    def __init__(self, tier: str, max_traces: int = 512, max_spans: int = 128,
+                 registry: metrics_lib.Registry | None = None):
         self.tier = tier
         self.max_traces = max_traces
         self.max_spans = max_spans
-        self._traces: OrderedDict[str, list[Span]] = OrderedDict()
+        self._traces: OrderedDict[str, _TraceEntry] = OrderedDict()
         self._lock = threading.Lock()
+        self.evicted_traces = 0  # ring evictions (any class), process total
+        self.dropped_spans = 0   # spans past a trace's span cap, process total
+        self._m = (
+            metrics_lib.trace_retention_metrics(registry)
+            if registry is not None else None
+        )
+
+    def _evict_one_locked(self) -> None:
+        """Drop one trace to make room: the oldest routine/unclassified one,
+        or -- only when every resident trace is protected -- the oldest
+        overall (the ring must stay bounded even under a pure error storm).
+        """
+        victim = None
+        for trace_id, entry in self._traces.items():  # oldest first
+            if entry.cls is None or entry.cls == "routine":
+                victim = trace_id
+                break
+        if victim is None:
+            victim, entry = next(iter(self._traces.items()))
+        else:
+            entry = self._traces[victim]
+        del self._traces[victim]
+        self.evicted_traces += 1
+        if self._m is not None:
+            counter = self._m["dropped"].get(entry.cls or "routine")
+            if counter is not None:
+                counter.inc()
 
     def record(
         self,
@@ -139,14 +210,39 @@ class Tracer:
             start_s, max(0.0, dur_s), tags,
         )
         with self._lock:
-            spans = self._traces.get(trace_id)
-            if spans is None:
+            entry = self._traces.get(trace_id)
+            if entry is None:
                 while len(self._traces) >= self.max_traces:
-                    self._traces.popitem(last=False)
-                spans = self._traces[trace_id] = []
-            if len(spans) < self.max_spans:
-                spans.append(span)
+                    self._evict_one_locked()
+                entry = self._traces[trace_id] = _TraceEntry()
+            if len(entry.spans) < self.max_spans:
+                entry.spans.append(span)
+            else:
+                entry.dropped_spans += 1
+                self.dropped_spans += 1
         return span
+
+    def classify(self, trace_id: str, cls: str) -> None:
+        """Stamp a finished trace's retention class (handlers call this in
+        their finally block).  Upgrades only: a trace already classified
+        more severe (a hedged request whose first attempt errored) keeps
+        the severer class."""
+        if cls not in RETENTION_PRIORITY:
+            cls = "routine"
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                return  # already evicted (or never recorded): nothing to keep
+            prev = entry.cls
+            if prev is not None and (
+                RETENTION_PRIORITY[prev] >= RETENTION_PRIORITY[cls]
+            ):
+                return
+            entry.cls = cls
+        if self._m is not None:
+            counter = self._m["retained"].get(cls)
+            if counter is not None:
+                counter.inc()
 
     def request_trace(self, trace_id: str, parent_id: str | None = None) -> "RequestTrace":
         """A RequestTrace rooted at a freshly minted span id; the caller
@@ -156,20 +252,46 @@ class Tracer:
 
     def spans(self, trace_id: str) -> list[dict] | None:
         with self._lock:
-            spans = self._traces.get(trace_id)
-            if spans is None:
+            entry = self._traces.get(trace_id)
+            if entry is None:
                 return None
-            return [s.to_dict() for s in spans]
+            return [s.to_dict() for s in entry.spans]
+
+    def trace_info(self, trace_id: str) -> dict | None:
+        """The /debug/trace view of one trace: spans plus the retention
+        class and this trace's dropped-span count (a nonzero count marks a
+        TRUNCATED waterfall -- the instrumentation fired, the ring cap
+        bit)."""
+        with self._lock:
+            entry = self._traces.get(trace_id)
+            if entry is None:
+                return None
+            return {
+                "spans": [s.to_dict() for s in entry.spans],
+                "retention_class": entry.cls or "routine",
+                "spans_dropped": entry.dropped_spans,
+            }
+
+    def stats(self) -> dict:
+        """Tier-level ring accounting, surfaced on /debug/trace 404s so a
+        missing trace reads as "probably evicted" vs "never instrumented"."""
+        with self._lock:
+            return {
+                "traces_resident": len(self._traces),
+                "max_traces": self.max_traces,
+                "traces_evicted_total": self.evicted_traces,
+                "spans_dropped_total": self.dropped_spans,
+            }
 
     def summary(self, trace_id: str) -> str:
         """Server-Timing-style summary: ``name;dur=12.3, ...`` (ms), in
         record order.  Empty string when the trace is unknown."""
         with self._lock:
-            spans = self._traces.get(trace_id)
-            if not spans:
+            entry = self._traces.get(trace_id)
+            if entry is None or not entry.spans:
                 return ""
             return ", ".join(
-                f"{s.name};dur={s.dur_s * 1e3:.1f}" for s in spans
+                f"{s.name};dur={s.dur_s * 1e3:.1f}" for s in entry.spans
             )
 
 

@@ -131,6 +131,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--overload-ab", "6"], "overload_ab"),
         (["--chaos-ab", "6"], "chaos_ab"),
         (["--crosshost-ab", "30"], "crosshost_ab"),
+        (["--obs-overhead-ab", "5"], "obs_overhead_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -221,6 +222,72 @@ def test_dry_run_multimodel_ab_echoes_the_scheduler_config():
     assert out["multimodel"]["light_deadline_ms"] == 200.0
     assert out["multimodel"]["rate_x"] == 3.0
     assert out["multimodel"]["light_rps"] == 40.0
+
+
+# --- observability-overhead A/B: CLI surface smoke + the 2% bar -----------
+
+
+def test_dry_run_obs_overhead_ab_echoes_the_observability_config():
+    # The --obs-overhead-ab invocation surface (the SLO/attribution/
+    # exemplar layer's cost guard) must keep parsing and echo its resolved
+    # knobs without importing jax, binding ports, or spawning servers.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--obs-overhead-ab", "4", "--dry-run",
+         "--obs-clients", "8", "--obs-device-ms", "1.5", "--obs-rounds", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=60,
+    )
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "obs_overhead_ab"
+    assert out["obs_overhead"]["duration_s"] == 4.0
+    assert out["obs_overhead"]["clients"] == 8
+    assert out["obs_overhead"]["device_ms"] == 1.5
+    assert out["obs_overhead"]["rounds"] == 3
+
+
+@pytest.mark.slow
+def test_obs_overhead_ab_full_layer_costs_at_most_two_percent():
+    """ISSUE 7's acceptance bar (slow: several closed-loop HTTP rounds):
+    the full observability layer -- SLO windows, exemplars, tail-based
+    retention -- holds >= 98% of the observability-off throughput, and the
+    on arm proves the layer actually engaged (exemplars on /metrics, the
+    model on /debug/slo)."""
+    bench = _bench_module()
+    out, rc = bench.bench_obs_overhead_ab(
+        duration_s=3.0, clients=8, rounds=2
+    )
+    assert rc == 0, out
+    assert out["value"] >= 0.98, out
+    assert out["layer_engaged"] is True
+
+
+@pytest.mark.slow
+def test_overload_ab_slo_view_agrees_with_client_ground_truth():
+    """The /debug/slo acceptance cross-check: the admission arm's
+    server-side SLO window must account every request the open-loop client
+    resolved (completions + sheds), and its good count must reconcile with
+    the client-side in-deadline 200s.  Exact equality is not required --
+    the deadline clock is measured at two different points (client
+    scheduled-send vs server header receipt) -- but the counts must agree
+    closely, not directionally."""
+    bench = _bench_module()
+    out, rc = bench.bench_overload_ab(duration_s=4.0)
+    assert rc == 0, out
+    arm = out["arms"]["admission"]
+    slo = arm["slo_view"]
+    assert slo is not None, "admission arm must expose /debug/slo"
+    row = slo["5m"]
+    resolved = arm["completed_200"] + arm["shed_5xx"]
+    # Every client-resolved request is in the server's window (the server
+    # can additionally have seen requests the client gave up on).
+    assert row["total"] >= resolved - 1
+    # In-deadline goodput: server-side good within a small tolerance of the
+    # client-side in-deadline completions (both clocks run the same budget).
+    client_good = round(arm["goodput_rps"] * 4.0)
+    assert abs(row["good"] - client_good) <= max(3, 0.1 * client_good), (
+        row, arm,
+    )
 
 
 @pytest.mark.slow

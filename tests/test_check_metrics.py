@@ -82,3 +82,50 @@ def test_lint_exempts_central_module_construction():
     assert all(
         "direct" not in v for v in check_metrics.lint_source(src, path)
     )
+
+
+_METRICS_PATH = os.path.join(
+    "kubernetes_deep_learning_tpu", "utils", "metrics.py"
+)
+
+
+def test_lint_flags_slo_series_minted_outside_central_module():
+    src = 'reg.gauge("kdlt_slo_burn_rate", "rogue slice")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "kdlt_slo_" in v and "central" in v
+    # The central module itself mints the matrix.
+    assert check_metrics.lint_source(src, _METRICS_PATH) == []
+
+
+def test_lint_flags_exemplar_on_non_histogram_mutation():
+    (v,) = check_metrics.lint_source(
+        'c.inc(1, exemplar="rid")\n', "fake.py"
+    )
+    assert "exemplar" in v and "histogram" in v
+    (v,) = check_metrics.lint_source(
+        'g.set(1.0, exemplar="rid")\n', "fake.py"
+    )
+    assert "exemplar" in v
+    # observe() is the sanctioned carrier.
+    assert check_metrics.lint_source(
+        'h.observe(0.1, exemplar="rid")\n', "fake.py"
+    ) == []
+
+
+def test_lint_flags_bounded_window_and_class_labels_outside_central():
+    (v,) = check_metrics.lint_source(
+        'reg.with_labels(window="5m")\n', "fake.py"
+    )
+    assert "window" in v and "central" in v
+    # "class" is a reserved word, so it arrives via **{"class": ...}.
+    (v,) = check_metrics.lint_source(
+        'reg.with_labels(**{"class": "error"})\n', "fake.py"
+    )
+    assert "class" in v
+    # Unbounded labels stay free, and the central module is exempt.
+    assert check_metrics.lint_source(
+        'reg.with_labels(tier="gateway")\n', "fake.py"
+    ) == []
+    assert check_metrics.lint_source(
+        'reg.with_labels(window="5m")\n', _METRICS_PATH
+    ) == []

@@ -414,6 +414,83 @@ def test_multimodel_scheduler_and_default_model_wiring():
         )
 
 
+def test_model_server_hpa_scales_on_minted_serving_signals():
+    """The model-tier HPA (ROADMAP multi-model gap #4) must scale on metric
+    names the serving path actually mints: every metric named in the HPA
+    must appear as a literal series name in utils/metrics.py (the single
+    minting point check_metrics.py enforces), and the scale target must be
+    the StatefulSet the deployment manifest declares."""
+    k8s = os.path.join(DEPLOY, "k8s")
+    (hpa,) = _yaml_docs(os.path.join(k8s, "model-server-hpa.yaml"))
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+
+    ref = hpa["spec"]["scaleTargetRef"]
+    assert ref["kind"] == model_dep["kind"]
+    assert ref["name"] == model_dep["metadata"]["name"]
+
+    metrics_src = _read(os.path.join(
+        REPO, "kubernetes_deep_learning_tpu", "utils", "metrics.py"
+    ))
+    names = [
+        m["pods"]["metric"]["name"]
+        for m in hpa["spec"]["metrics"] if m["type"] == "Pods"
+    ]
+    assert "kdlt_slo_burn_rate" in names, (
+        "the HPA must consume the SLO engine's burn-rate signal"
+    )
+    assert "kdlt_sched_floor_boosts_total" in names, (
+        "the HPA must consume the scheduler's starvation-floor signal"
+    )
+    for name in names:
+        assert f'"{name}"' in metrics_src, (
+            f"HPA scales on {name!r}, which utils/metrics.py does not mint "
+            "-- the autoscaler would read a nonexistent series"
+        )
+    # The burn-rate metric must select a real SLO window label value.
+    from kubernetes_deep_learning_tpu.utils import slo as slo_lib
+
+    (burn,) = [
+        m["pods"]["metric"] for m in hpa["spec"]["metrics"]
+        if m["type"] == "Pods" and m["pods"]["metric"]["name"] == "kdlt_slo_burn_rate"
+    ]
+    window = burn["selector"]["matchLabels"]["window"]
+    assert window in [label for label, _ in slo_lib.WINDOWS]
+
+
+def test_slo_target_agrees_across_every_tier_and_topology():
+    """KDLT_SLO_TARGET drives burn rates on BOTH tiers (gateway = client-
+    observed, model tier = server-side) and in both topologies; a
+    disagreement would make the two views burn at different rates against
+    the same traffic, by construction."""
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    (compose,) = _yaml_docs(os.path.join(DEPLOY, "docker-compose.yaml"))
+
+    def k8s_env(dep, name):
+        (container,) = dep["spec"]["template"]["spec"]["containers"]
+        return {e["name"]: e.get("value") for e in container["env"]}.get(name)
+
+    targets = {
+        "k8s/model-server": k8s_env(model_dep, "KDLT_SLO_TARGET"),
+        "k8s/gateway": k8s_env(gw_dep, "KDLT_SLO_TARGET"),
+    }
+    for svc_name, svc in compose["services"].items():
+        targets[f"compose/{svc_name}"] = (
+            svc.get("environment", {}).get("KDLT_SLO_TARGET")
+        )
+    assert all(v is not None for v in targets.values()), targets
+    assert len(set(targets.values())) == 1, (
+        f"KDLT_SLO_TARGET disagrees across tiers: {targets}"
+    )
+    # And the value must parse as a usable target.
+    from kubernetes_deep_learning_tpu.utils import slo as slo_lib
+
+    value = float(next(iter(targets.values())))
+    assert 0.0 < value < 1.0
+    assert slo_lib.resolve_target(value) == value
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

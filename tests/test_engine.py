@@ -57,6 +57,57 @@ def test_bucket_selection(engine):
         eng.bucket_for(9)
 
 
+def test_engine_flops_per_image_from_lowered_cost_analysis(engine):
+    # The live-MFU FLOPs path: lowering-only cost analysis of the exact
+    # flax graph (no XLA compile, no device work).  On any backend that
+    # supports cost analysis it must produce a positive, batch-normalized
+    # figure; None is the accepted degraded answer elsewhere.
+    eng, _, _ = engine
+    flops = eng._flops_per_image(2)
+    assert flops is not None and flops > 0
+    # FLOPs/image is ~batch-invariant (same math per row).
+    flops1 = eng._flops_per_image(1)
+    assert flops1 == pytest.approx(flops, rel=0.2)
+
+
+def test_mfu_accountant_gauges_and_busy_ratio():
+    from kubernetes_deep_learning_tpu.runtime import flops as flops_lib
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    registry = metrics_lib.Registry()
+    acct = flops_lib.MfuAccountant(
+        registry, peak_tf=1e-9,  # 1000 FLOP/s "device": tiny, predictable
+        flops_fn=lambda bucket: 100.0, enabled=True,
+    )
+    # First observation queues the background FLOPs estimate; wait for it,
+    # then observe again so the gauge exists with a value.
+    acct.observe(4, 4, 0.5)
+    deadline = __import__("time").monotonic() + 5.0
+    while not acct.snapshot() and __import__("time").monotonic() < deadline:
+        acct.observe(4, 4, 0.5)
+        __import__("time").sleep(0.01)
+    # 4 rows x 100 FLOP / (0.5 s x 1000 FLOP/s) = 80% MFU.
+    assert acct.snapshot()[4] == pytest.approx(80.0, abs=1.0)
+    page = registry.render()
+    assert 'kdlt_mfu_pct{bucket="4"}' in page
+    assert "kdlt_device_busy_ratio" in page
+
+
+def test_mfu_accountant_disabled_without_peak():
+    from kubernetes_deep_learning_tpu.runtime import flops as flops_lib
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    registry = metrics_lib.Registry()
+    acct = flops_lib.MfuAccountant(
+        registry, peak_tf=None, flops_fn=lambda b: 100.0
+    )
+    assert acct.enabled is False
+    acct.observe(4, 4, 0.1)  # busy accounting still runs; MFU does not
+    assert acct.snapshot() == {}
+    assert "kdlt_device_busy_ratio" in registry.render()
+    assert "kdlt_mfu_pct" not in registry.render()
+
+
 def test_input_validation(engine):
     eng, _, _ = engine
     with pytest.raises(ValueError, match="expected"):

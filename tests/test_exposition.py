@@ -28,6 +28,9 @@ _TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|unty
 # parser fails the page.
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
 _SAMPLE_RE = re.compile(rf"^({_NAME})(\{{.*\}})? ([^ ]+)$")
+# OpenMetrics exemplar annotation (KDLT_METRICS_EXEMPLARS=1):
+#   name_bucket{le="x"} 12 # {trace_id="abc"} 0.034 1622.5
+_EXEMPLAR_RE = re.compile(r"^\{(.*)\} ([^ ]+)( [^ ]+)?$")
 
 
 class ExpositionError(AssertionError):
@@ -74,7 +77,33 @@ def parse_exposition(text: str) -> dict:
                 seen_done.add(current)
             current = name
             continue
-        m = _SAMPLE_RE.match(line)
+        # Split off an OpenMetrics exemplar annotation before the classic
+        # sample grammar applies (the annotation is only legal on histogram
+        # _bucket samples -- enforced below).
+        exemplar = None
+        sample_part = line
+        if " # " in line:
+            sample_part, _, ex_raw = line.partition(" # ")
+            em = _EXEMPLAR_RE.match(ex_raw)
+            if em is None:
+                raise ExpositionError(
+                    f"line {lineno}: malformed exemplar {ex_raw!r}"
+                )
+            ex_labels_raw, ex_value_raw, _ex_ts = em.groups()
+            matched = _LABEL_RE.findall(ex_labels_raw)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
+            if rebuilt != ex_labels_raw:
+                raise ExpositionError(
+                    f"line {lineno}: malformed exemplar labels "
+                    f"{ex_labels_raw!r}"
+                )
+            try:
+                exemplar = (dict(matched), float(ex_value_raw))
+            except ValueError as e:
+                raise ExpositionError(
+                    f"line {lineno}: bad exemplar value {ex_value_raw!r}"
+                ) from e
+        m = _SAMPLE_RE.match(sample_part)
         if m is None:
             raise ExpositionError(f"line {lineno}: unparsable sample {line!r}")
         sample_name, labels_raw, value_raw = m.groups()
@@ -108,6 +137,18 @@ def parse_exposition(text: str) -> dict:
         except ValueError as e:
             raise ExpositionError(f"line {lineno}: bad value {value_raw!r}") from e
         families[fam_name]["samples"].append((sample_name, labels, value))
+        if exemplar is not None:
+            if (
+                families[fam_name]["type"] != "histogram"
+                or not sample_name.endswith("_bucket")
+            ):
+                raise ExpositionError(
+                    f"line {lineno}: exemplar on non-histogram-bucket sample "
+                    f"{sample_name!r}"
+                )
+            families[fam_name].setdefault("exemplars", []).append(
+                (sample_name, labels, exemplar[0], exemplar[1])
+            )
 
     for name, fam in families.items():
         if fam["type"] is None:
@@ -194,6 +235,56 @@ def test_parser_rejects_non_monotonic_histogram():
     )
     with pytest.raises(ExpositionError, match="monotonic"):
         parse_exposition(bad)
+
+
+def test_parser_rejects_exemplar_on_counter():
+    bad = '# HELP m h\n# TYPE m counter\nm 1 # {trace_id="abc"} 1 1622.5\n'
+    with pytest.raises(ExpositionError, match="non-histogram"):
+        parse_exposition(bad)
+
+
+# --- exemplars: annotated round-trip on, byte-identical legacy off ----------
+
+
+def test_exemplar_round_trip_with_flag_on(monkeypatch):
+    monkeypatch.setenv(metrics_lib.EXEMPLARS_ENV, "1")
+    r = metrics_lib.Registry()
+    h = r.histogram("kdlt_test_latency_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="rid-fast")
+    h.observe(0.5, exemplar="rid-slow")
+    h.observe(0.07)  # later un-exemplared observation keeps the exemplar
+    text = r.render()
+    fams = parse_exposition(text)  # strict parse survives the annotation
+    exemplars = {
+        labels["le"]: (ex_labels["trace_id"], value)
+        for _name, labels, ex_labels, value
+        in fams["kdlt_test_latency_seconds"]["exemplars"]
+    }
+    # Each exemplar sits on the bucket its observation landed in, carrying
+    # the observed value (not the bucket bound).
+    assert exemplars["0.1"] == ("rid-fast", 0.05)
+    assert exemplars["1.0"] == ("rid-slow", 0.5)
+
+
+def test_exposition_byte_identical_with_flag_off(monkeypatch):
+    monkeypatch.delenv(metrics_lib.EXEMPLARS_ENV, raising=False)
+
+    def build(with_exemplars: bool) -> str:
+        r = metrics_lib.Registry()
+        r.counter("kdlt_test_total", "c").inc()
+        h = r.histogram("kdlt_test_latency_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="rid" if with_exemplars else None)
+        h.observe(2.0, exemplar="rid2" if with_exemplars else None)
+        return r.render()
+
+    # A histogram that RECEIVED exemplars renders byte-identically to one
+    # that never did, as long as the env gate is off: legacy scrapers see
+    # the exact pre-exemplar exposition.
+    assert build(True) == build(False)
+    monkeypatch.setenv(metrics_lib.EXEMPLARS_ENV, "1")
+    annotated = build(True)
+    assert annotated != build(False)
+    assert '# {trace_id="rid"}' in annotated
 
 
 # --- the fix itself: grouped HELP/TYPE for same-name labeled series --------

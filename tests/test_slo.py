@@ -1,0 +1,309 @@
+"""SLO engine (utils/slo.py): window/burn-rate math on synthetic request
+streams, gauge refresh, and the /debug/slo surfaces of both live tiers
+(the gateway merging the model tier's view), plus the exemplar link from a
+burning histogram back to its traces.
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+
+import numpy as np
+import pytest
+import requests
+
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import slo as slo_lib
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_engine(target=0.9, **kw):
+    clock = FakeClock()
+    eng = slo_lib.SloEngine(
+        metrics_lib.Registry(), tier="test", enabled=True, target=target,
+        clock=clock, **kw,
+    )
+    return eng, clock
+
+
+# --- the window / burn-rate math -------------------------------------------
+
+
+def test_burn_rate_math_against_synthetic_stream():
+    eng, clock = make_engine(target=0.9)  # error budget = 10%
+    for _ in range(80):
+        eng.record("m", 200, 0.01)
+    for _ in range(10):
+        eng.record("m", 503, 0.0)
+    for _ in range(10):
+        eng.record("m", 500, 0.0)
+    row = eng.model_windows()["m"]["5m"]
+    assert row["total"] == 100
+    assert row["good"] == 80
+    assert row["goodput_ratio"] == pytest.approx(0.8)
+    # bad fraction 0.2 over a 0.1 budget: burning 2x the sustainable rate.
+    assert row["burn_rate"] == pytest.approx(2.0)
+    assert row["shed_ratio"] == pytest.approx(0.1)
+    assert row["error_ratio"] == pytest.approx(0.1)
+
+
+def test_windows_age_out_independently():
+    eng, clock = make_engine(target=0.99)
+    for _ in range(10):
+        eng.record("m", 500, 0.0)  # a burst of errors
+    clock.advance(400)  # past 5m, inside 1h
+    for _ in range(10):
+        eng.record("m", 200, 0.01)
+    rows = eng.model_windows()["m"]
+    # 5m: only the recent good traffic; the burst aged out.
+    assert rows["5m"]["total"] == 10
+    assert rows["5m"]["burn_rate"] == 0.0
+    # 1h: burst still visible -- 10 bad of 20 -> burn 0.5/0.01 = 50x.
+    assert rows["1h"]["total"] == 20
+    assert rows["1h"]["burn_rate"] == pytest.approx(50.0)
+    clock.advance(3700)  # everything aged out
+    rows = eng.model_windows()["m"]
+    assert rows["1h"]["total"] == 0
+    assert rows["1h"]["burn_rate"] == 0.0
+    assert rows["1h"]["goodput_ratio"] == 1.0  # quiet != burning
+
+
+def test_client_errors_excluded_from_the_slo():
+    eng, _ = make_engine(target=0.9)
+    for _ in range(10):
+        eng.record("m", 200, 0.01)
+    for _ in range(90):
+        eng.record("m", 400, 0.0)  # the callers' fault
+    row = eng.model_windows()["m"]["5m"]
+    assert row["client"] == 90
+    assert row["goodput_ratio"] == 1.0  # 10/10 eligible
+    assert row["burn_rate"] == 0.0
+
+
+def test_deadline_and_latency_objective_violations_are_late():
+    eng, _ = make_engine(target=0.9, latency_objective_ms=100.0)
+    eng.record("m", 200, 0.01)                            # good
+    eng.record("m", 200, 0.01, deadline_exceeded=True)    # late via deadline
+    eng.record("m", 200, 0.5)                             # late via objective
+    row = eng.model_windows()["m"]["5m"]
+    assert row["good"] == 1 and row["late"] == 2
+    assert row["goodput_ratio"] == pytest.approx(1 / 3)
+
+
+def test_refresh_sets_gauges_and_metrics_page_is_bounded():
+    eng, _ = make_engine(target=0.9)
+    registry = eng._registry
+    for _ in range(8):
+        eng.record("heavy", 200, 0.01)
+    eng.record("heavy", 503, 0.0)
+    eng.refresh()
+    page = registry.render()
+    assert 'kdlt_slo_burn_rate{tier="test",model="heavy",window="5m"}' in page
+    assert 'window="1h"' in page
+    # Refreshing twice must not re-mint (the registry dedupes by design).
+    eng.refresh()
+
+
+def test_merge_model_views_sums_counts_and_rederives():
+    a = {"m": {"5m": {"total": 10, "good": 9, "late": 0, "shed": 1,
+                      "error": 0, "client": 0}}}
+    b = {"m": {"5m": {"total": 10, "good": 7, "late": 0, "shed": 0,
+                      "error": 3, "client": 0}}}
+    merged = slo_lib.merge_model_views([a, b], target=0.9)
+    row = merged["m"]["5m"]
+    assert row["total"] == 20 and row["good"] == 16
+    assert row["goodput_ratio"] == pytest.approx(0.8)
+    assert row["burn_rate"] == pytest.approx(2.0)
+
+
+def test_resolve_target_clamps_and_survives_garbage(monkeypatch):
+    monkeypatch.setenv(slo_lib.SLO_TARGET_ENV, "0.999")
+    assert slo_lib.resolve_target() == pytest.approx(0.999)
+    monkeypatch.setenv(slo_lib.SLO_TARGET_ENV, "bogus")
+    assert slo_lib.resolve_target() == slo_lib.DEFAULT_SLO_TARGET
+    # 1.0 would make every burn rate infinite; clamp below it.
+    assert slo_lib.resolve_target(1.0) < 1.0
+
+
+def test_disabled_engine_is_inert():
+    eng = slo_lib.SloEngine(
+        metrics_lib.Registry(), tier="test", enabled=False
+    )
+    eng.record("m", 500, 0.0)
+    assert eng.refresh() == {}
+    assert eng.debug_payload()["enabled"] is False
+
+
+# --- both live tiers' /debug/slo + the exemplar link -----------------------
+
+
+@pytest.fixture(scope="module")
+def slo_stack():
+    import os
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    spec = register_spec(
+        ModelSpec(
+            name="slo-stub", family="xception",
+            input_shape=(16, 16, 3), labels=("a", "b"),
+        )
+    )
+    root = tempfile.mkdtemp(prefix="kdlt-slo-")
+    art.save_artifact(
+        art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    prev = os.environ.get(metrics_lib.EXEMPLARS_ENV)
+    os.environ[metrics_lib.EXEMPLARS_ENV] = "1"
+    server = ModelServer(
+        root, port=0, buckets=(1, 2), host="127.0.0.1", batcher_impl="python",
+        engine_factory=lambda a, **kw: StubEngine(a, async_device=True, **kw),
+    )
+    server.warmup()
+    server.start()
+    gateway = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name, port=0,
+        host="127.0.0.1",
+    )
+    gateway.start()
+    yield server, gateway, spec
+    if prev is None:
+        os.environ.pop(metrics_lib.EXEMPLARS_ENV, None)
+    else:
+        os.environ[metrics_lib.EXEMPLARS_ENV] = prev
+    gateway.shutdown()
+    server.shutdown()
+
+
+def _predict_ok(server, spec, n=1):
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+
+    img = np.zeros((1, 16, 16, 3), np.uint8)
+    for _ in range(n):
+        requests.post(
+            f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict",
+            data=protocol.encode_predict_request(img),
+            headers={
+                "Content-Type": protocol.MSGPACK_CONTENT_TYPE,
+                DEADLINE_HEADER: "5000",
+            },
+            timeout=30,
+        ).raise_for_status()
+
+
+def test_model_server_debug_slo_counts_agree_with_traffic(slo_stack):
+    server, _, spec = slo_stack
+    before = requests.get(
+        f"http://127.0.0.1:{server.port}/debug/slo", timeout=5
+    ).json()
+    seen = (
+        before.get("models", {}).get(spec.name, {}).get("5m", {})
+        .get("total", 0)
+    )
+    _predict_ok(server, spec, n=5)
+    body = requests.get(
+        f"http://127.0.0.1:{server.port}/debug/slo", timeout=5
+    ).json()
+    assert body["tier"] == "model-server" and body["enabled"] is True
+    row = body["models"][spec.name]["5m"]
+    # The engine's count must agree exactly with the traffic sent (the
+    # acceptance criterion's +-1-request bar, at unit scale).
+    assert row["total"] == seen + 5
+    assert row["good"] >= 5
+    assert row["burn_rate"] == 0.0
+
+
+def test_gateway_debug_slo_merges_replica_views(slo_stack):
+    server, gateway, spec = slo_stack
+    _predict_ok(server, spec, n=2)
+    r = requests.post(
+        f"http://127.0.0.1:{gateway.port}/predict",
+        json={"url": "not-a-url"},
+        timeout=30,
+    )
+    assert r.status_code == 400  # unfetchable URL: a client-class outcome
+    body = requests.get(
+        f"http://127.0.0.1:{gateway.port}/debug/slo", timeout=5
+    ).json()
+    assert body["tier"] == "gateway"
+    # The gateway's own (client-observed) view saw the /predict attempt...
+    gw_row = body["gateway"][spec.name]["5m"]
+    assert gw_row["client"] >= 1
+    # ...and the merged view carries the model tier's counts per replica.
+    host = f"127.0.0.1:{server.port}"
+    assert host in body["replicas"]
+    merged = body["merged"][spec.name]["5m"]
+    direct = body["replicas"][host]["models"][spec.name]["5m"]
+    assert merged["total"] == direct["total"] >= 2
+
+
+def test_slo_gauges_and_exemplars_on_live_metrics_page(slo_stack):
+    from test_exposition import parse_exposition
+
+    server, _, spec = slo_stack
+    _predict_ok(server, spec, n=3)
+    text = requests.get(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=5
+    ).text
+    fams = parse_exposition(text)
+    assert "kdlt_slo_burn_rate" in fams
+    assert "kdlt_slo_goodput_ratio" in fams
+    # The burn-rate gauge carries the bounded (model, window) matrix.
+    windows = {
+        labels.get("window")
+        for _, labels, _ in fams["kdlt_slo_burn_rate"]["samples"]
+    }
+    assert windows == {"5m", "1h"}
+    # Exemplars (KDLT_METRICS_EXEMPLARS=1 in this stack): the request
+    # latency histogram links a bucket to a trace id, and the annotated
+    # page still parses strictly.
+    exemplars = fams["kdlt_server_request_seconds"].get("exemplars", [])
+    assert exemplars, "latency histogram should carry a trace exemplar"
+    trace_id = exemplars[0][2]["trace_id"]
+    # The exemplar links to a real retained trace on /debug/trace.
+    r = requests.get(
+        f"http://127.0.0.1:{server.port}/debug/trace/{trace_id}", timeout=5
+    )
+    assert r.status_code == 200
+    assert r.json()["spans"]
+
+
+def test_trace_retention_counters_on_live_page(slo_stack):
+    server, _, spec = slo_stack
+    _predict_ok(server, spec, n=1)
+    text = requests.get(
+        f"http://127.0.0.1:{server.port}/metrics", timeout=5
+    ).text
+    assert 'kdlt_trace_retained_total{class="routine"' in text
+
+
+def test_client_renders_slo_table(slo_stack):
+    from kubernetes_deep_learning_tpu.serving import client as client_lib
+
+    server, gateway, spec = slo_stack
+    _predict_ok(server, spec, n=1)
+    payload = client_lib.fetch_slo(f"http://127.0.0.1:{gateway.port}")
+    out = client_lib.render_slo(payload)
+    assert "burn" in out and spec.name in out
+    assert "merged" in out
+    # And the CLI flag drives the same path end to end.
+    rc = client_lib.main([
+        "--gateway", f"http://127.0.0.1:{gateway.port}", "--slo",
+    ])
+    assert rc == 0

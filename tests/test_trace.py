@@ -82,6 +82,73 @@ def test_span_recorded_even_when_block_raises():
     assert [s["name"] for s in t.spans("rid")] == ["failing"]
 
 
+def test_tracer_counts_dropped_spans_instead_of_silently_evicting():
+    # The pre-PR-7 bug: spans past the cap vanished without a trace, so a
+    # truncated waterfall read as missing instrumentation.
+    t = trace_lib.Tracer("test", max_spans=4)
+    for _ in range(10):
+        t.record("rid", "s", trace_lib.now_s(), 0.001)
+    info = t.trace_info("rid")
+    assert len(info["spans"]) == 4
+    assert info["spans_dropped"] == 6
+    assert t.stats()["spans_dropped_total"] == 6
+
+
+def test_tail_based_retention_protects_interesting_traces():
+    t = trace_lib.Tracer("test", max_traces=4)
+    for i in range(4):
+        t.record(f"t{i}", "root", trace_lib.now_s(), 0.001)
+    t.classify("t0", "error")   # oldest, but protected
+    t.classify("t1", "shed")
+    # Two new traces force two evictions: the ROUTINE t2/t3 go first even
+    # though t0/t1 are older.
+    t.record("t4", "root", trace_lib.now_s(), 0.001)
+    t.record("t5", "root", trace_lib.now_s(), 0.001)
+    assert t.spans("t0") is not None and t.spans("t1") is not None
+    assert t.spans("t2") is None and t.spans("t3") is None
+    assert t.evicted_traces == 2
+    # All protected: the ring still stays bounded (oldest protected goes).
+    t.classify("t4", "deadline")
+    t.classify("t5", "slow")
+    t.record("t6", "root", trace_lib.now_s(), 0.001)
+    assert t.spans("t0") is None  # oldest protected was the fallback victim
+
+
+def test_classify_upgrades_only():
+    t = trace_lib.Tracer("test")
+    t.record("rid", "root", trace_lib.now_s(), 0.001)
+    t.classify("rid", "error")
+    t.classify("rid", "slow")  # must not downgrade
+    assert t.trace_info("rid")["retention_class"] == "error"
+    t.classify("missing", "error")  # unknown trace: a no-op, not a KeyError
+
+
+def test_retention_metrics_count_retained_and_dropped():
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    r = metrics_lib.Registry()
+    t = trace_lib.Tracer("test", max_traces=2, registry=r)
+    t.record("a", "root", trace_lib.now_s(), 0.001)
+    t.classify("a", "error")
+    t.record("b", "root", trace_lib.now_s(), 0.001)
+    t.classify("b", "routine")
+    t.record("c", "root", trace_lib.now_s(), 0.001)  # evicts routine b
+    page = r.render()
+    assert 'kdlt_trace_retained_total{class="error"} 1' in page
+    assert 'kdlt_trace_dropped_total{class="routine"} 1' in page
+    assert t.spans("a") is not None
+
+
+def test_retention_class_mapping():
+    rc = trace_lib.retention_class
+    assert rc(503) == "shed" and rc(504) == "shed"
+    assert rc(500) == "error" and rc(-1) == "error"
+    assert rc(200, deadline_exceeded=True) == "deadline"
+    assert rc(200, slow=True) == "slow"
+    assert rc(200) == "routine"
+    assert rc(400) == "routine"  # the caller's fault is not worth retaining
+
+
 def test_ensure_span_id_sanitizes():
     assert trace_lib.ensure_span_id(None) is None
     assert trace_lib.ensure_span_id("abc\r\nX: 1") == "abcX1"

@@ -24,7 +24,15 @@ Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
   outside utils/metrics.py is flagged -- modules attach the label through
   utils.metrics.model_registry / model_version_registry and friends, which
   is what keeps its cardinality BOUNDED (MODEL_LABEL_CAP + the overflow
-  bucket) no matter what names a caller feeds in.
+  bucket) no matter what names a caller feeds in.  The same rule covers
+  the other bounded labels: ``window`` (the SLO engine's fixed window set)
+  and ``class`` (the tracer's retention classes);
+- ``kdlt_slo_*`` series must be minted inside utils/metrics.py: the SLO
+  engine's gauge matrix is (bounded model) x (fixed window), and a module
+  minting its own slice would bypass both bounds at once;
+- ``exemplar=`` is histogram-only (the OpenMetrics rule): passing it to a
+  counter/gauge mutation (``.inc()``/``.set()``) is flagged -- at runtime
+  it would TypeError, but the lint catches it before a request does.
 """
 
 from __future__ import annotations
@@ -39,6 +47,14 @@ EXTRA_FILES = ("bench.py",)
 METRIC_PREFIX = "kdlt_"
 MINT_METHODS = {"counter", "gauge", "histogram"}
 METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+# Labels whose value sets are bounded by construction inside utils/metrics.py
+# (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
+# the trace retention classes) -- attaching them anywhere else escapes the
+# bound.
+CENTRAL_LABELS = {"model", "window", "class"}
+# Series prefixes whose minting is confined to utils/metrics.py even beyond
+# the general helper conventions.
+CENTRAL_PREFIXES = ("kdlt_slo_",)
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
 SKIP_PARTS = {"tfs_gen", "__pycache__"}
 
@@ -109,19 +125,47 @@ def lint_source(src: str, rel: str) -> list[str]:
                 "through a Registry / the utils.metrics helpers instead"
             )
             continue
-        # The bounded `model` label: with_labels(model=...) may only happen
-        # inside the central module (model_registry and friends); anywhere
-        # else it bypasses the cardinality cap and the memoized dedupe.
+        # The bounded labels: with_labels(model=.../window=.../class=...)
+        # may only happen inside the central module (model_registry, the
+        # slo/retention helpers); anywhere else it bypasses the cardinality
+        # caps and the memoized dedupe.  Keyword "class" also arrives as
+        # with_labels(**{"class": ...}) -- a dict-literal double-star with
+        # a matching constant key counts too.
         if (
             not is_metrics_module
             and isinstance(fn, ast.Attribute)
             and fn.attr == "with_labels"
-            and any(kw.arg == "model" for kw in node.keywords)
+        ):
+            bounded = {
+                kw.arg for kw in node.keywords if kw.arg in CENTRAL_LABELS
+            }
+            for kw in node.keywords:
+                if kw.arg is None and isinstance(kw.value, ast.Dict):
+                    bounded.update(
+                        k.value for k in kw.value.keys
+                        if isinstance(k, ast.Constant)
+                        and k.value in CENTRAL_LABELS
+                    )
+            if bounded:
+                labels = ", ".join(sorted(bounded))
+                violations.append(
+                    f"{rel}:{node.lineno}: .with_labels({labels}=...) outside "
+                    "utils/metrics.py; mint bounded labels through the "
+                    "central helpers (model_registry / "
+                    "slo_model_window_metrics / trace_retention_metrics)"
+                )
+                continue
+        # Exemplars are a histogram concept (OpenMetrics): counter/gauge
+        # mutations must not carry one.
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("inc", "set")
+            and any(kw.arg == "exemplar" for kw in node.keywords)
         ):
             violations.append(
-                f"{rel}:{node.lineno}: .with_labels(model=...) outside "
-                "utils/metrics.py; mint the model label through the central "
-                "helpers (model_registry / model_version_registry)"
+                f"{rel}:{node.lineno}: exemplar= on .{fn.attr}(); exemplars "
+                "attach to histogram observe() only (non-histogram series "
+                "cannot carry them)"
             )
             continue
         # Mint calls: .counter / .gauge / .histogram on anything (in this
@@ -140,6 +184,14 @@ def lint_source(src: str, rel: str) -> list[str]:
                 violations.append(
                     f"{rel}:{node.lineno}: metric name {head!r} is not "
                     f"{METRIC_PREFIX}-prefixed"
+                )
+            elif not is_metrics_module and any(
+                head.startswith(p) for p in CENTRAL_PREFIXES
+            ):
+                violations.append(
+                    f"{rel}:{node.lineno}: {head!r} minted outside "
+                    "utils/metrics.py; kdlt_slo_* series are minted only by "
+                    "the central SLO helpers (bounded model x window matrix)"
                 )
     return violations
 
