@@ -1933,8 +1933,18 @@ def bench_obs_overhead_ab(duration_s=5.0, device_ms=0.0, clients=16,
 
 def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
                    rate_rps=24.0, hedge_delay_ms=150.0, probe_interval_s=0.5,
-                   kill_at_frac=0.4, seed=0):
+                   kill_at_frac=0.4, seed=0, mode="kill"):
     """Fault-tolerance A/B: hard-kill 1 of 2 model-tier replicas mid-run.
+
+    ``mode="stall"`` is the cross-host LEADER arm (ROADMAP cross-host gap
+    #1): instead of killing the victim, its shared dispatcher declares a
+    terminal stall (InFlightDispatcher.declare_stall -- exactly what the
+    engine watchdog does when a wedged device sync strands the pipeline),
+    so the replica keeps answering fast 503s carrying X-Kdlt-Stalled and
+    fails its own /healthz.  The gateway must treat that declared stall
+    like a replica death -- immediate mark-out + in-request failover --
+    so a coalesced flight that dialed the stalled leader fails over
+    instead of stranding all its waiters.
 
     Device-free acceptance harness for the serving-path fault-tolerance
     layer (serving.upstream + serving.faults + the dispatcher watchdog's
@@ -1998,12 +2008,13 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
     )
     threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
     img_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+    verb = "killed" if mode == "kill" else "dispatch-stalled"
     log(
-        f"chaos A/B: 2 stub replicas ({device_ms}ms/batch), {rate_rps:g} "
-        f"req/s x {duration_s}s = {n_requests} requests, deadline "
-        f"{deadline_ms:.0f}ms, replica A killed at t+{kill_after_s:.1f}s, "
-        f"hedge {hedge_delay_ms:.0f}ms, probe {probe_interval_s:g}s, "
-        f"seed {seed}"
+        f"chaos A/B ({mode}): 2 stub replicas ({device_ms}ms/batch), "
+        f"{rate_rps:g} req/s x {duration_s}s = {n_requests} requests, "
+        f"deadline {deadline_ms:.0f}ms, replica A {verb} at "
+        f"t+{kill_after_s:.1f}s, hedge {hedge_delay_ms:.0f}ms, probe "
+        f"{probe_interval_s:g}s, seed {seed}"
     )
 
     def start_replica() -> ModelServer:
@@ -2013,8 +2024,12 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
         )
         server = ModelServer(
             root, port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+            # The stall arm needs the async engine surface: ServedModel
+            # then serves through the scheduler's shared
+            # InFlightDispatcher, the thing whose stall is being staged.
             engine_factory=lambda a, **kw: StubEngine(
-                a, device_ms_per_batch=device_ms, **kw
+                a, device_ms_per_batch=device_ms,
+                async_device=(mode == "stall"), **kw
             ),
         )
         server.warmup()
@@ -2029,6 +2044,11 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
             failover=failover_on,
             hedge_delay_ms=hedge_delay_ms if failover_on else 0,
             probe_interval_s=probe_interval_s,
+            # One repeated URL: the response cache would absorb every
+            # request after the first and nothing would touch upstream --
+            # this A/B measures the failover path (bench.py --cache-ab
+            # owns the cache's own A/B).
+            cache=False,
         )
         gw.start()
         gw.spec  # discover the contract before the clock starts
@@ -2066,10 +2086,21 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
         for t in threads:
             t.start()
 
+        stall_mark: dict = {}
+
         def kill() -> None:
             delay = kill_at - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
+            if mode == "stall":
+                # The leader arm: the replica stays up but its dispatch
+                # pipeline is declared terminally stalled (the watchdog's
+                # own action, invoked directly).  From this instant every
+                # predict answers a fast 503 + X-Kdlt-Stalled and
+                # /healthz fails, so the prober can never rejoin it.
+                stall_mark["pre"] = victim._m_requests.value
+                victim.scheduler.dispatcher.declare_stall()
+                return
             # Hard-fail the replica: every in-flight/keep-alive predict
             # drops its connection mid-request (deterministic injected
             # disconnect, seeded), and the listener closes so new connects
@@ -2089,8 +2120,18 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
             t.join(timeout=max(0.0, end_by - time.monotonic()))
         killer.join(timeout=10.0)
         gw_metrics = gw.registry.render()
+        # Stall mode's fix-proving signal: how many requests the gateway
+        # kept feeding the wedged replica AFTER the stall was declared.
+        # With the mark-out fix one observation suffices; blind
+        # round-robin keeps dialing it for its share of the traffic.
+        victim_touches = (
+            int(victim._m_requests.value - stall_mark["pre"])
+            if mode == "stall" and "pre" in stall_mark else None
+        )
         gw.shutdown()
         survivor.shutdown()
+        if mode == "stall":
+            victim.shutdown()  # kill mode shut it down mid-run
         sched = [t_base + i / rate_rps for i in range(n_requests)]
         done = [
             (sched[i], lat, status)
@@ -2128,17 +2169,22 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
             ),
             "post_kill_failures": len(post_failures),
             "recovery_s": round(recovery_s, 3),
+            "post_kill_victim_requests": victim_touches,
             "failover_total": metric("kdlt_upstream_failover_total"),
             "hedge_fired_total": metric("kdlt_hedge_fired_total"),
             "hedge_won_total": metric("kdlt_hedge_won_total"),
         }
+        touched = (
+            "" if victim_touches is None
+            else f", {victim_touches} requests fed to the stalled replica"
+        )
         log(
             f"  failover={'on ' if failover_on else 'off'}: post-kill "
             f"{arm['post_kill_in_deadline_rate'] * 100:5.1f}% in-deadline "
             f"({len(post_ok)}/{len(post_kill)}), recovery {recovery_s:.2f}s, "
             f"{arm['failover_total']:.0f} failovers, "
             f"{arm['hedge_fired_total']:.0f} hedges fired "
-            f"({arm['hedge_won_total']:.0f} won)"
+            f"({arm['hedge_won_total']:.0f} won){touched}"
         )
         return arm
 
@@ -2150,14 +2196,32 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
     # Recovery bound: in-request failover means failures should stop almost
     # immediately; one probe interval (+ scheduling grace) is the ceiling.
     recovery_bound_s = probe_interval_s + 0.5
-    ok = (
-        arm_on["post_kill_in_deadline_rate"] >= 0.95
-        and arm_on["recovery_s"] <= recovery_bound_s
-        and arm_off["post_kill_in_deadline_rate"] < 0.85
-    )
+    if mode == "stall":
+        # A declared stall answers FAST 503s, so even the blind arm's
+        # backoff retry recovers inside a generous deadline -- goodput
+        # alone cannot separate the arms.  The fix's signal is traffic
+        # placement: the health-aware pool stops feeding the wedged
+        # replica after the FIRST X-Kdlt-Stalled observation (<= 3 allows
+        # concurrent in-flight observers), while blind round-robin keeps
+        # sending it its full share.
+        off_share = arm_off["post_kill_victim_requests"] / max(
+            1, arm_off["post_kill_requests"]
+        )
+        ok = (
+            arm_on["post_kill_in_deadline_rate"] >= 0.95
+            and arm_on["post_kill_victim_requests"] <= 3
+            and off_share >= 0.25
+        )
+    else:
+        ok = (
+            arm_on["post_kill_in_deadline_rate"] >= 0.95
+            and arm_on["recovery_s"] <= recovery_bound_s
+            and arm_off["post_kill_in_deadline_rate"] < 0.85
+        )
     out = {
         "metric": (
-            f"serving-path chaos A/B (2 stub replicas, 1 hard-killed at "
+            f"serving-path chaos A/B (2 stub replicas, 1 "
+            f"{'hard-killed' if mode == 'kill' else 'dispatch-stalled'} at "
             f"t+{kill_after_s:.1f}s of {duration_s:g}s, {deadline_ms:.0f}ms "
             f"deadline): post-kill in-deadline success with failover+hedging "
             f"on vs off; recovery {arm_on['recovery_s']:.2f}s "
@@ -2170,12 +2234,266 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
             / max(arm_off["post_kill_in_deadline_rate"], 1e-9),
             2,
         ),
+        "mode": mode,
         "deadline_ms": deadline_ms,
         "rate_rps": rate_rps,
         "hedge_delay_ms": hedge_delay_ms,
         "probe_interval_s": probe_interval_s,
         "seed": seed,
         "arms": {"failover_on": arm_on, "failover_off": arm_off},
+    }
+    return out, 0 if ok else 1
+
+
+def bench_cache_ab(duration_s=6.0, device_ms=50.0, deadline_ms=800.0,
+                   rate_rps=60.0, zipf_alpha=1.1, universe=64, probe_n=16,
+                   seed=0):
+    """Content-addressed cache + singleflight A/B on a Zipf workload.
+
+    A REAL Gateway fronts ONE stub-backed ModelServer replica; an
+    open-loop client fires single-image /predict requests for
+    ``duration_s`` at ``rate_rps``, with URLs drawn Zipf(``zipf_alpha``)
+    over ``universe`` distinct URLs -- every URL serves the same local
+    PNG bytes under a distinct query string, so the cache sees distinct
+    identities while the model tier's work per miss is identical.  The
+    offered load is set ~2x the stub tier's capacity (``device_ms`` per
+    batch over buckets (1, 2)), so the cache-off arm sheds: the win the
+    cache claims -- goodput under overload -- is the thing measured.
+
+    Two arms on the same seeded schedule: cache+coalescing ON vs OFF
+    (the KDLT_CACHE=0 posture).  After the timed arms, two proofs run on
+    the ON gateway: a singleflight probe (``probe_n`` identical
+    concurrent requests against a fresh URL must produce EXACTLY ONE
+    upstream dispatch) and a miss-parity check (a fresh URL's response
+    through the ON arm must be bit-identical to the OFF arm's for the
+    same URL -- the cache must never perturb the miss path).
+
+    Returns (json_dict, rc); rc=0 iff hit_ratio >= 0.5 AND on-arm
+    in-deadline goodput strictly beats off-arm AND the singleflight probe
+    counted exactly 1 upstream dispatch AND miss-path responses are
+    bit-identical.
+    """
+    import tempfile
+    import threading
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    import requests
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    spec = register_spec(
+        ModelSpec(
+            name="cache-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    deadline_s = deadline_ms / 1e3
+    n_requests = int(duration_s * rate_rps)
+    rng = np.random.default_rng(seed)
+    # Zipf(alpha) over exactly `universe` ranks (np.random's zipf samples
+    # an unbounded tail; serving workloads have a finite catalog).
+    ranks = np.arange(1, universe + 1, dtype=np.float64)
+    pmf = ranks ** (-zipf_alpha)
+    pmf /= pmf.sum()
+    url_ranks = rng.choice(universe, size=n_requests, p=pmf)
+    img_dir = tempfile.mkdtemp(prefix="kdlt-cache-img-")
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(img_dir, "img.png"))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    base_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+    log(
+        f"cache A/B: Zipf(alpha={zipf_alpha:g}) over {universe} urls, "
+        f"{rate_rps:g} req/s x {duration_s}s = {n_requests} requests, "
+        f"stub tier {device_ms}ms/batch (buckets 1-2), deadline "
+        f"{deadline_ms:.0f}ms, seed {seed}"
+    )
+
+    def start_stack(cache_on: bool) -> tuple:
+        root = tempfile.mkdtemp(prefix="kdlt-cache-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        server = ModelServer(
+            root, port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+            engine_factory=lambda a, **kw: StubEngine(
+                a, device_ms_per_batch=device_ms, **kw
+            ),
+        )
+        server.warmup()
+        server.start()
+        gw = Gateway(
+            serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+            port=0, host="127.0.0.1", cache=cache_on,
+        )
+        gw.start()
+        gw.spec  # discover the contract before the clock starts
+        return server, gw
+
+    def run_arm(cache_on: bool) -> tuple[dict, object, object]:
+        server, gw = start_stack(cache_on)
+        url = f"http://127.0.0.1:{gw.port}/predict"
+        session = requests.Session()
+        session.mount("http://", requests.adapters.HTTPAdapter(
+            pool_connections=4, pool_maxsize=256,
+        ))
+        results: list = [None] * n_requests
+
+        def fire(i: int, at: float) -> None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                r = session.post(
+                    url,
+                    json={"url": f"{base_url}?u={int(url_ranks[i])}"},
+                    headers={DEADLINE_HEADER: f"{deadline_ms:.1f}"},
+                    timeout=deadline_s + 5.0,
+                )
+                status = r.status_code
+            except Exception:
+                status = -1
+            # Open-loop latency from the SCHEDULED send time.
+            results[i] = (time.monotonic() - at, status)
+
+        t_base = time.monotonic() + 0.25
+        threads = [
+            threading.Thread(
+                target=fire, args=(i, t_base + i / rate_rps), daemon=True
+            )
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        end_by = t_base + duration_s + max(2.0, 2 * deadline_s)
+        for t in threads:
+            t.join(timeout=max(0.0, end_by - time.monotonic()))
+        done = [r for r in results if r is not None]
+        ok = [lat for lat, status in done if status == 200 and lat <= deadline_s]
+        cache_stats = requests.get(
+            f"http://127.0.0.1:{gw.port}/debug/cache", timeout=5
+        ).json()
+        arm = {
+            "cache": cache_on,
+            "requests": n_requests,
+            "resolved": len(done),
+            "in_deadline": len(ok),
+            "goodput_rps": round(len(ok) / duration_s, 2),
+            "in_deadline_rate": round(len(ok) / max(1, len(done)), 4),
+            "p50_ms": round(
+                float(np.median(ok)) * 1e3, 1
+            ) if ok else None,
+            "hit_ratio": cache_stats.get("hit_ratio", 0.0),
+            "hits": cache_stats.get("hits", 0),
+            "misses": cache_stats.get("misses", 0),
+            "coalesced": cache_stats.get("coalesced", 0),
+        }
+        log(
+            f"  cache={'on ' if cache_on else 'off'}: goodput "
+            f"{arm['goodput_rps']:6.1f} req/s in-deadline "
+            f"({arm['in_deadline']}/{len(done)}), hit_ratio "
+            f"{arm['hit_ratio']:.3f}, {arm['coalesced']} coalesced"
+        )
+        return arm, server, gw
+
+    def parity_scores(gw_port: int, tag: str) -> dict:
+        r = requests.post(
+            f"http://127.0.0.1:{gw_port}/predict",
+            json={"url": f"{base_url}?{tag}"},
+            timeout=30.0,
+        )
+        r.raise_for_status()
+        return r.json()
+
+    try:
+        arm_on, server_on, gw_on = run_arm(True)
+        arm_off, server_off, gw_off = run_arm(False)
+        # Singleflight proof on the ON stack: N identical concurrent
+        # requests against a never-seen URL -> exactly 1 upstream dispatch
+        # (the stub tier's request counter is the ground truth).
+        probe_url = f"{base_url}?probe=1"
+        before = server_on._m_requests.value
+        barrier = threading.Barrier(probe_n)
+
+        def probe() -> None:
+            barrier.wait()
+            try:
+                requests.post(
+                    f"http://127.0.0.1:{gw_on.port}/predict",
+                    json={"url": probe_url}, timeout=30.0,
+                )
+            except Exception:  # noqa: BLE001 - the dispatch count is the proof
+                pass
+
+        probes = [
+            threading.Thread(target=probe, daemon=True) for _ in range(probe_n)
+        ]
+        for t in probes:
+            t.start()
+        for t in probes:
+            t.join(timeout=30.0)
+        upstream_dispatches = int(server_on._m_requests.value - before)
+        # Miss-parity proof: a fresh URL through the ON gateway (a cache
+        # miss) must produce byte-identical scores to the OFF gateway.
+        on_scores = parity_scores(gw_on.port, "parity=1")
+        off_scores = parity_scores(gw_off.port, "parity=1")
+        miss_bit_identical = json.dumps(on_scores, sort_keys=True) == (
+            json.dumps(off_scores, sort_keys=True)
+        )
+        gw_on.shutdown()
+        server_on.shutdown()
+        gw_off.shutdown()
+        server_off.shutdown()
+    finally:
+        img_httpd.shutdown()
+    log(
+        f"  singleflight probe: {probe_n} identical concurrent requests -> "
+        f"{upstream_dispatches} upstream dispatch(es); miss parity "
+        f"{'bit-identical' if miss_bit_identical else 'DIVERGED'}"
+    )
+    ok = (
+        arm_on["hit_ratio"] >= 0.5
+        and arm_on["goodput_rps"] > arm_off["goodput_rps"]
+        and upstream_dispatches == 1
+        and miss_bit_identical
+    )
+    out = {
+        "metric": (
+            f"gateway cache+singleflight A/B (Zipf alpha={zipf_alpha:g} "
+            f"over {universe} urls at {rate_rps:g} req/s, stub tier "
+            f"{device_ms:.0f}ms/batch, {deadline_ms:.0f}ms deadline): "
+            f"in-deadline goodput with the cache on vs off"
+        ),
+        "value": arm_on["goodput_rps"],
+        "unit": "in-deadline goodput req/s (cache on)",
+        "vs_baseline": round(
+            arm_on["goodput_rps"] / max(arm_off["goodput_rps"], 1e-9), 2
+        ),
+        "hit_ratio": arm_on["hit_ratio"],
+        "singleflight_upstream_dispatches": upstream_dispatches,
+        "singleflight_probe_n": probe_n,
+        "miss_bit_identical": miss_bit_identical,
+        "zipf_alpha": zipf_alpha,
+        "universe": universe,
+        "rate_rps": rate_rps,
+        "deadline_ms": deadline_ms,
+        "seed": seed,
+        "arms": {"cache_on": arm_on, "cache_off": arm_off},
     }
     return out, 0 if ok else 1
 
@@ -2251,6 +2569,10 @@ def bench_trace_breakdown(n_requests=30, device_ms=60.0, deadline_ms=5000.0,
     gateway = Gateway(
         serving_host=f"127.0.0.1:{server.port}", model=spec.name, port=0,
         host="127.0.0.1",
+        # Repeated URLs: with the cache on every request after the first
+        # would be a 2-span cache hit; this mode attributes the FULL
+        # gateway->model-tier path.
+        cache=False,
     )
     gateway.start()
     log(
@@ -2759,6 +3081,56 @@ def main() -> int:
         help="deterministic seed for the --chaos-ab request schedule",
     )
     p.add_argument(
+        "--chaos-mode", default="kill", choices=["kill", "stall"],
+        help="--chaos-ab failure mode: 'kill' hard-kills the victim "
+             "replica (listener closed, connections dropped); 'stall' is "
+             "the cross-host LEADER arm -- the victim's dispatch pipeline "
+             "declares a terminal stall (watchdog semantics), so it keeps "
+             "answering fast X-Kdlt-Stalled 503s and the gateway must "
+             "mark it out on the FIRST observation",
+    )
+    p.add_argument(
+        "--cache-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: gateway cache+singleflight A/B -- "
+             "drive a real gateway + stub model tier with a Zipf-"
+             "distributed URL workload at ~2x capacity for this many "
+             "seconds per arm (cache on vs KDLT_CACHE=0 off; no device "
+             "needed; rc=0 iff hit_ratio >= 0.5, the on arm wins "
+             "in-deadline goodput, N identical concurrent requests "
+             "produce exactly 1 upstream dispatch, and miss-path "
+             "responses are bit-identical to cache-off)",
+    )
+    p.add_argument(
+        "--cache-device-ms", type=float, default=50.0,
+        help="simulated device ms per batch for the --cache-ab stub tier "
+             "(sets capacity; the offered rate should overload it)",
+    )
+    p.add_argument(
+        "--cache-deadline-ms", type=float, default=800.0,
+        help="per-request deadline budget for --cache-ab",
+    )
+    p.add_argument(
+        "--cache-rate-rps", type=float, default=60.0,
+        help="offered request rate for --cache-ab",
+    )
+    p.add_argument(
+        "--cache-zipf-alpha", type=float, default=1.1,
+        help="Zipf exponent of the --cache-ab URL popularity distribution",
+    )
+    p.add_argument(
+        "--cache-universe", type=int, default=64,
+        help="distinct URLs in the --cache-ab workload",
+    )
+    p.add_argument(
+        "--cache-probe-n", type=int, default=16,
+        help="identical concurrent requests for the --cache-ab "
+             "singleflight proof (must produce exactly 1 upstream dispatch)",
+    )
+    p.add_argument(
+        "--cache-seed", type=int, default=0,
+        help="deterministic seed for the --cache-ab URL schedule",
+    )
+    p.add_argument(
         "--trace-breakdown", type=int, default=0, metavar="N",
         help="INSTEAD of the sweep: send N traced requests through a stub "
              "gateway->model-server stack and attribute each request's "
@@ -2844,8 +3216,8 @@ def main() -> int:
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
                      "batcher_sweep", "host_saturation", "overload_ab",
-                     "chaos_ab", "trace_breakdown", "multimodel_ab",
-                     "obs_overhead_ab"):
+                     "chaos_ab", "cache_ab", "trace_breakdown",
+                     "multimodel_ab", "obs_overhead_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -2874,6 +3246,17 @@ def main() -> int:
                 "hedge_ms": args.chaos_hedge_ms,
                 "probe_s": args.chaos_probe_s,
                 "seed": args.chaos_seed,
+                "mode": args.chaos_mode,
+            },
+            "cache": {
+                "duration_s": args.cache_ab,
+                "device_ms": args.cache_device_ms,
+                "deadline_ms": args.cache_deadline_ms,
+                "rate_rps": args.cache_rate_rps,
+                "zipf_alpha": args.cache_zipf_alpha,
+                "universe": args.cache_universe,
+                "probe_n": args.cache_probe_n,
+                "seed": args.cache_seed,
             },
             "trace": {
                 "requests": args.trace_breakdown,
@@ -3003,6 +3386,21 @@ def main() -> int:
             hedge_delay_ms=args.chaos_hedge_ms,
             probe_interval_s=args.chaos_probe_s,
             seed=args.chaos_seed,
+            mode=args.chaos_mode,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.cache_ab > 0:
+        out, rc = bench_cache_ab(
+            duration_s=args.cache_ab,
+            device_ms=args.cache_device_ms,
+            deadline_ms=args.cache_deadline_ms,
+            rate_rps=args.cache_rate_rps,
+            zipf_alpha=args.cache_zipf_alpha,
+            universe=args.cache_universe,
+            probe_n=args.cache_probe_n,
+            seed=args.cache_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

@@ -431,24 +431,33 @@ class InFlightDispatcher:
         ]
         if not overdue:
             return False
-        # The completion thread materializes in FIFO order, so one stuck
-        # handle blocks every later in-flight batch too: fail ALL current
-        # waiters (retryable), stop intake, and flip unhealthy -- this
-        # process needs a restart, its callers need another replica.
-        self._stalled.set()
-        with self._inflight_lock:
-            stranded = list(self._inflight.items())
-            self._inflight.clear()
         import logging
 
         logging.getLogger(__name__).error(
             "dispatch watchdog: %d in-flight batch(es) stuck past their "
-            "stall bound (oldest %.1fs); failing %d waiter(s) and marking "
-            "the pipeline stalled",
+            "stall bound (oldest %.1fs); failing waiters and marking the "
+            "pipeline stalled",
             len(overdue),
             max(now - t0 for _, (_, _, t0) in entries),
-            len(stranded),
         )
+        self.declare_stall()
+        return True
+
+    def declare_stall(self) -> None:
+        """Declare the pipeline terminally stalled: fail every in-flight
+        waiter retryably, stop intake, flip unhealthy.
+
+        The completion thread materializes in FIFO order, so one stuck
+        handle blocks every later in-flight batch too -- this process
+        needs a restart, its callers need another replica.  The watchdog
+        is the normal caller; chaos tooling (bench.py --chaos-ab's stall
+        arm) calls it directly to stage a wedged replica without waiting
+        out a real device hang.
+        """
+        self._stalled.set()
+        with self._inflight_lock:
+            stranded = list(self._inflight.items())
+            self._inflight.clear()
         for _token, (fut, _n, _t0) in stranded:
             self._m_stalls.inc()
             try:
@@ -460,7 +469,6 @@ class InFlightDispatcher:
                     )
             except Exception:  # noqa: BLE001 - racing completion
                 pass
-        return True
 
     def close(self, drain: bool = True) -> None:
         """Stop intake, drain every in-flight batch, stop the completion

@@ -216,6 +216,20 @@ class AdmissionController:
         if counter is not None:
             counter.inc()
 
+    def count_coalesced(self, model: str | None = None) -> None:
+        """Record a cache-coalesced singleflight follower: admitted-but-
+        not-dispatched.  It IS served (through the leader's flight), so it
+        counts as seen + admitted -- but it consumes no limiter slot and
+        no in-flight ledger entry, because exactly one request (the
+        leader) holds real gateway capacity for the whole flight.
+        kdlt_cache_coalesced_total carries the distinction."""
+        mm = self._model_metrics(model)
+        self._m["requests"].inc()
+        self._m["admitted"].inc()
+        if mm is not None:
+            mm["requests"].inc()
+            mm["admitted"].inc()
+
     def _release(self, queue_wait_s: float, overloaded: bool, headroom: bool) -> None:
         if self._limiter is not None:
             self._limiter.release(

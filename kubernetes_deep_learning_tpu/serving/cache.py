@@ -1,0 +1,407 @@
+"""Gateway content-addressed response cache + singleflight coalescing.
+
+At millions-of-users scale the same public image URLs recur heavily, yet
+until now every duplicate request rode the full gateway -> admission ->
+preprocess -> model-tier path.  This module is the classic serving-layer
+answer (Clipper's prediction cache, NSDI '17; Go's singleflight), hosted
+where the paper's two-tier split wants it -- the IO tier:
+
+- **content addressing**: a request is identified by the sha256 of its
+  canonicalized form -- model name + the model's *resolved artifact hash*
+  (the registry's sha256 identity, learned from the model tier's
+  ``X-Kdlt-Artifact-Hash`` response header) + preprocessing parameters
+  (input shape, resize filter) + the payload (the image URL) + an optional
+  client salt (``X-Kdlt-Cache-Bust``).  Keying on the artifact hash, not
+  the version number, is what makes hot-reload semantics exact: a version
+  bump with byte-identical content keeps every entry; changed bytes change
+  the hash and drop that model's entries (:meth:`ResponseCache.note_artifact_hash`).
+
+- **singleflight coalescing** (:class:`SingleFlight`): identical in-flight
+  requests collapse into ONE upstream call whose result fans out to every
+  waiter.  Deadline semantics are per-waiter: a follower whose own budget
+  expires gets its own 504 without cancelling the leader, and hedging/
+  failover fire once per *flight* (only the leader talks upstream), not
+  once per caller.
+
+- **bounded LRU response cache** (:class:`ResponseCache`): successful
+  responses only, TTL'd (``KDLT_CACHE_TTL_S``), capped by byte budget
+  (``KDLT_CACHE_MAX_MB``), with ``KDLT_CACHE=0`` as the subsystem kill
+  switch (no cache, no coalescing -- the exact legacy gateway).
+
+A hit avoids admission, preprocessing, and all device work, so it raises
+goodput under overload *and* cuts p50 at idle; the gateway therefore
+checks the cache AHEAD of admission (hits never consume AIMD concurrency
+slots; coalesced followers are counted admitted-but-not-dispatched).
+All ``kdlt_cache_*`` series are minted centrally in utils/metrics.py
+(tools/check_metrics.py confines the prefix there).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from kubernetes_deep_learning_tpu.serving.protocol import (  # noqa: F401 - re-exported wire surface
+    ARTIFACT_HASH_HEADER,
+    CACHE_BUST_HEADER,
+    CACHE_STATUS_HEADER,
+)
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+CACHE_ENV = "KDLT_CACHE"
+TTL_ENV = "KDLT_CACHE_TTL_S"
+MAX_MB_ENV = "KDLT_CACHE_MAX_MB"
+
+# Staleness ceiling between an artifact reload and the first miss that
+# teaches the gateway the new hash; 60 s matches the version watcher's
+# default poll cadence (one watcher period of bounded staleness).
+DEFAULT_TTL_S = 60.0
+DEFAULT_MAX_MB = 64.0
+
+# A client salt is hashed, never echoed, but still bound it: a multi-KB
+# header must not become free amplification of the hash input.
+MAX_BUST_SALT_LEN = 128
+
+# The artifact-hash slot of a key before any upstream response has taught
+# the gateway the real one (process start, or a model never yet served).
+UNRESOLVED_HASH = "unresolved"
+
+WSGI_CACHE_BUST_KEY = "HTTP_X_KDLT_CACHE_BUST"
+
+
+def cache_enabled(explicit: bool | None = None) -> bool:
+    """Explicit arg > $KDLT_CACHE > enabled-by-default (the kill switch
+    disables the whole subsystem: response cache AND coalescing)."""
+    if explicit is not None:
+        return bool(explicit)
+    raw = os.environ.get(CACHE_ENV, "").strip().lower()
+    return raw not in ("0", "false", "off", "no")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+def content_key(
+    model: str,
+    artifact_hash: str,
+    preprocess_params: str,
+    payload: str | bytes,
+    salt: str = "",
+) -> str:
+    """sha256 over the canonicalized request, length-prefixed per field.
+
+    Length prefixes keep the concatenation unambiguous (``("a", "bc")``
+    and ``("ab", "c")`` must not collide); the fields are exactly the
+    ISSUE's canonical form: model name, resolved artifact hash,
+    preprocessing params, payload bytes, plus the cache-bust salt.
+    """
+    h = hashlib.sha256()
+    for field in (model, artifact_hash, preprocess_params, payload,
+                  salt[:MAX_BUST_SALT_LEN]):
+        data = field.encode() if isinstance(field, str) else bytes(field)
+        h.update(str(len(data)).encode())
+        h.update(b":")
+        h.update(data)
+    return h.hexdigest()
+
+
+class FlightTimeout(TimeoutError):
+    """A coalesced follower's own deadline expired before the flight
+    resolved; the follower 504s, the leader keeps flying."""
+
+
+class Flight:
+    """One in-flight upstream computation; followers block on :meth:`wait`.
+
+    The leader resolves it exactly once with the finished response (or
+    fails it with the leader's escaped exception); every waiter observes
+    the same outcome, each bounded by its OWN timeout.
+    """
+
+    __slots__ = ("_done", "_value", "_error", "followers", "started_s")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+        self.followers = 0
+        self.started_s = time.monotonic()
+
+    def resolve(self, value) -> None:
+        self._value = value
+        self._done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    def wait(self, timeout_s: float | None):
+        if not self._done.wait(timeout_s):
+            raise FlightTimeout(
+                "deadline expired waiting on the coalesced flight"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class SingleFlight:
+    """Key -> at most one live Flight; later arrivals join as followers.
+
+    The leader MUST call :meth:`finish` before resolving/failing its
+    flight (pop-then-resolve): a request arriving after the pop starts a
+    fresh flight instead of receiving a result computed under a deadline
+    that is not its own.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: dict[str, Flight] = {}
+
+    def begin(self, key: str) -> tuple[Flight, bool]:
+        """Join or start the key's flight; returns (flight, is_leader)."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is not None:
+                flight.followers += 1
+                return flight, False
+            flight = Flight()
+            self._flights[key] = flight
+            return flight, True
+
+    def finish(self, key: str, flight: Flight) -> None:
+        """Detach a completed flight (leader-only; identity-checked so a
+        raced replacement flight is never evicted by a stale leader)."""
+        with self._lock:
+            if self._flights.get(key) is flight:
+                del self._flights[key]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "inflight_flights": len(self._flights),
+                "waiting_followers": sum(
+                    f.followers for f in self._flights.values()
+                ),
+            }
+
+
+class _Entry:
+    __slots__ = ("body", "ctype", "nbytes", "model", "artifact_hash",
+                 "expires_s", "stored_s", "hits")
+
+    def __init__(self, body, ctype, model, artifact_hash, expires_s):
+        self.body = body
+        self.ctype = ctype
+        self.nbytes = len(body)
+        self.model = model
+        self.artifact_hash = artifact_hash
+        self.expires_s = expires_s
+        self.stored_s = time.monotonic()
+        self.hits = 0
+
+
+class ResponseCache:
+    """Bounded, TTL'd, artifact-hash-invalidated LRU of 200 responses.
+
+    Stores ``(body, ctype)`` keyed by content hash.  Thread-safe; all
+    sizing is by response-body bytes against the ``KDLT_CACHE_MAX_MB``
+    budget.  Invalidation is two-layered: the content key already embeds
+    the resolved artifact hash (a reload changes future keys), and
+    :meth:`note_artifact_hash` eagerly drops the superseded entries so the
+    byte budget is not squatted by unreachable stale data.
+    """
+
+    def __init__(
+        self,
+        registry: metrics_lib.Registry | None = None,
+        ttl_s: float | None = None,
+        max_mb: float | None = None,
+    ):
+        self.ttl_s = ttl_s if ttl_s is not None else _env_float(
+            TTL_ENV, DEFAULT_TTL_S
+        )
+        max_mb = max_mb if max_mb is not None else _env_float(
+            MAX_MB_ENV, DEFAULT_MAX_MB
+        )
+        self.max_bytes = int(max_mb * 1024 * 1024)
+        self._entries: OrderedDict[str, _Entry] = OrderedDict()
+        self._bytes = 0
+        self._hashes: dict[str, str] = {}  # model -> resolved artifact hash
+        self._lock = threading.Lock()
+        # Plain-int mirrors of the counters so /debug/cache works with or
+        # without a registry (tests construct bare caches).
+        self.hits = 0
+        self.misses = 0
+        self.coalesced = 0
+        self.evictions: dict[str, int] = {
+            reason: 0 for reason, _ in metrics_lib.CACHE_EVICTION_REASONS
+        }
+        self._m = (
+            metrics_lib.cache_metrics(registry) if registry is not None
+            else None
+        )
+
+    # --- artifact-hash identity ---------------------------------------------
+
+    def resolved_hash(self, model: str) -> str:
+        """The model's last-learned artifact hash (key material); a model
+        the gateway has never seen answer resolves to a sentinel, so the
+        first flight per process is simply an unmergeable one-off key."""
+        with self._lock:
+            return self._hashes.get(model, UNRESOLVED_HASH)
+
+    def note_artifact_hash(self, model: str, artifact_hash: str) -> None:
+        """Learn/refresh a model's artifact identity from an upstream
+        response.  A CHANGED hash is a hot reload with different bytes:
+        every entry stored under the old hash is dropped (reason
+        "reload").  An unchanged hash -- including a version bump that
+        re-exported identical bytes -- keeps all entries."""
+        if not artifact_hash:
+            return
+        with self._lock:
+            prev = self._hashes.get(model)
+            if prev == artifact_hash:
+                return
+            self._hashes[model] = artifact_hash
+            if prev is None:
+                return
+            stale = [
+                k for k, e in self._entries.items()
+                if e.model == model and e.artifact_hash != artifact_hash
+            ]
+            for k in stale:
+                self._evict_locked(k, "reload")
+            self._refresh_gauges_locked()
+
+    def count_coalesced(self) -> None:
+        """One singleflight follower rode an identical request's flight
+        (the gateway counts these here so /debug/cache and the metric
+        stay one source)."""
+        with self._lock:
+            self.coalesced += 1
+        self._count("coalesced")
+
+    # --- lookup / store -----------------------------------------------------
+
+    def count_miss(self) -> None:
+        """One lookup miss that went on to LEAD its own upstream flight
+        (followers of an existing flight count as ``coalesced`` instead,
+        so hits + misses + coalesced partitions the cacheable traffic and
+        hit_ratio compares flights avoided vs flights flown)."""
+        with self._lock:
+            self.misses += 1
+            self._count("misses")
+            self._refresh_gauges_locked()
+
+    def get(self, key: str) -> tuple[bytes, str] | None:
+        """Hit -> (body, ctype) and LRU-touch; miss/expired -> None (the
+        caller decides whether the miss leads a flight or coalesces, and
+        counts it via count_miss / count_coalesced)."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and 0 < self.ttl_s and entry.expires_s <= now:
+                self._evict_locked(key, "ttl")
+                entry = None
+            if entry is None:
+                self._refresh_gauges_locked()
+                return None
+            self._entries.move_to_end(key)
+            entry.hits += 1
+            self.hits += 1
+            self._count("hits")
+            self._refresh_gauges_locked()
+            return entry.body, entry.ctype
+
+    def put(
+        self, key: str, body: bytes, ctype: str, model: str,
+        artifact_hash: str,
+    ) -> bool:
+        """Store one successful response; returns False when the body
+        alone exceeds the whole byte budget (never cached)."""
+        if len(body) > self.max_bytes:
+            return False
+        expires = time.monotonic() + self.ttl_s
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            entry = _Entry(body, ctype, model, artifact_hash, expires)
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            if self._m is not None:
+                self._m["bytes"].inc(entry.nbytes)
+            while self._bytes > self.max_bytes and self._entries:
+                oldest = next(iter(self._entries))
+                if oldest == key:
+                    break  # never evict the entry being inserted
+                self._evict_locked(oldest, "lru")
+            self._refresh_gauges_locked()
+        return True
+
+    def invalidate_model(self, model: str) -> int:
+        """Drop every entry of one model (operator surface); returns the
+        count dropped."""
+        with self._lock:
+            stale = [
+                k for k, e in self._entries.items() if e.model == model
+            ]
+            for k in stale:
+                self._evict_locked(k, "reload")
+            self._refresh_gauges_locked()
+            return len(stale)
+
+    # --- internals ----------------------------------------------------------
+
+    def _evict_locked(self, key: str, reason: str) -> None:
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return
+        self._bytes -= entry.nbytes
+        self.evictions[reason] = self.evictions.get(reason, 0) + 1
+        if self._m is not None:
+            counter = self._m["evictions"].get(reason)
+            if counter is not None:
+                counter.inc()
+
+    def _count(self, name: str) -> None:
+        if self._m is not None:
+            self._m[name].inc()
+
+    def _refresh_gauges_locked(self) -> None:
+        if self._m is None:
+            return
+        self._m["resident"].set(float(self._bytes))
+        self._m["entries"].set(float(len(self._entries)))
+        total = self.hits + self.misses
+        self._m["hit_ratio"].set(self.hits / total if total else 0.0)
+
+    def stats(self) -> dict:
+        """The /debug/cache payload body (everything but the flights)."""
+        with self._lock:
+            total = self.hits + self.misses
+            per_model: dict[str, int] = {}
+            for e in self._entries.values():
+                per_model[e.model] = per_model.get(e.model, 0) + 1
+            return {
+                "entries": len(self._entries),
+                "resident_bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "ttl_s": self.ttl_s,
+                "hits": self.hits,
+                "misses": self.misses,
+                "coalesced": self.coalesced,
+                "hit_ratio": round(self.hits / total, 4) if total else 0.0,
+                "evictions": dict(self.evictions),
+                "entries_by_model": per_model,
+                "artifact_hashes": dict(self._hashes),
+            }

@@ -12,6 +12,7 @@ import json
 import random
 import sys
 import time
+import uuid
 
 import numpy as np
 
@@ -35,6 +36,7 @@ def predict_url(
     deadline_ms: float | None = None,
     stats: dict | None = None,
     model: str | None = None,
+    cache_bust: str | None = None,
 ) -> dict:
     """POST {"url": ...} to the gateway's /predict (reference test.py:15).
 
@@ -61,6 +63,14 @@ def predict_url(
     the exact default-model wire shape -- bare ``/predict``, no model
     header -- so deadline-unaware single-model deployments see zero
     change.
+
+    ``cache_bust`` salts the gateway's content-addressed response cache
+    via the X-Kdlt-Cache-Bust header so a load test can deliberately opt
+    out of cached answers (a random salt per request defeats the cache
+    entirely; a shared salt still coalesces identical concurrent
+    requests).  The gateway's cache disposition for the served request
+    (hit | miss | coalesced, from the X-Kdlt-Cache response header) lands
+    in ``stats["cache"]``.
     """
     import requests
 
@@ -77,6 +87,8 @@ def predict_url(
     if model is not None:
         path = f"/predict/{model}"
         headers[protocol.MODEL_HEADER] = model
+    if cache_bust is not None:
+        headers[protocol.CACHE_BUST_HEADER] = cache_bust
     t0 = time.monotonic()
     for attempt in range(retries + 1):
         try:
@@ -110,6 +122,9 @@ def predict_url(
 
             stats["request_id"] = r.headers.get(REQUEST_ID_HEADER, "")
             stats["trace_summary"] = r.headers.get(TRACE_HEADER, "")
+            # The gateway's cache disposition (hit | miss | coalesced);
+            # empty on batch requests or a cache-disabled gateway.
+            stats["cache"] = r.headers.get(protocol.CACHE_STATUS_HEADER, "")
             return r.json()
         try:
             retry_after = float(r.headers.get("Retry-After", ""))
@@ -215,6 +230,19 @@ def main(argv: list[str] | None = None) -> int:
         help="bounded retries on 503 shed responses (honors Retry-After)",
     )
     p.add_argument(
+        "--cache-bust", action="store_true",
+        help="salt the gateway's content-addressed response cache with a "
+        "random X-Kdlt-Cache-Bust header so this request deliberately "
+        "bypasses cached answers (load-test opt-out; identical salts "
+        "would still coalesce)",
+    )
+    p.add_argument(
+        "--stats", action="store_true",
+        help="after the prediction, print a per-request stats table: the "
+        "gateway's cache disposition (hit/miss/coalesced) and the retry "
+        "counters",
+    )
+    p.add_argument(
         "--trace", action="store_true",
         help="after the prediction, fetch /debug/trace/<rid> from the "
         "gateway (which merges the model tier's spans in) and render the "
@@ -235,8 +263,23 @@ def main(argv: list[str] | None = None) -> int:
         args.gateway, args.image_url,
         retries=args.retries, deadline_ms=args.deadline_ms, stats=stats,
         model=args.model,
+        cache_bust=uuid.uuid4().hex if args.cache_bust else None,
     )
     print(json.dumps(scores, indent=2))
+    if args.stats:
+        # One row per accounting dimension; "cache" is the gateway's
+        # disposition header (hit = served without admission/upstream/
+        # device work, coalesced = rode another request's flight, empty =
+        # cache disabled on the gateway).
+        rows = [
+            ("cache", stats.get("cache") or "-"),
+            ("retried_shed", str(stats.get("retried_shed", 0))),
+            ("retried_connect", str(stats.get("retried_connect", 0))),
+            ("request_id", stats.get("request_id") or "-"),
+        ]
+        print(f"{'stat':<16s} value", file=sys.stderr)
+        for name, value in rows:
+            print(f"{name:<16s} {value}", file=sys.stderr)
     if args.trace:
         from kubernetes_deep_learning_tpu.utils.trace import render_waterfall
 

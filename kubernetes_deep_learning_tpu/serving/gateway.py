@@ -48,6 +48,7 @@ from kubernetes_deep_learning_tpu.serving.admission import (
     install_sigterm_drain,
     retry_after_headers,
 )
+from kubernetes_deep_learning_tpu.serving import cache as cache_lib
 from kubernetes_deep_learning_tpu.serving import faults as faults_lib
 from kubernetes_deep_learning_tpu.serving.microbatch import UpstreamStall
 from kubernetes_deep_learning_tpu.serving.tracing import (
@@ -123,6 +124,9 @@ class Gateway:
         hedge_delay_ms: float | None = None,
         probe_interval_s: float | None = None,
         slo: bool | None = None,
+        cache: bool | None = None,
+        cache_ttl_s: float | None = None,
+        cache_max_mb: float | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -180,6 +184,21 @@ class Gateway:
         self.admission = AdmissionController(
             self.registry, tier="gateway", enabled=admission
         )
+        # Content-addressed response cache + singleflight coalescing
+        # (serving.cache): checked AHEAD of admission, so a hit consumes no
+        # AIMD concurrency slot, no preprocessing, and no upstream/device
+        # work, while identical in-flight misses collapse into ONE upstream
+        # flight (hedging fires once per flight, not per caller).
+        # cache=None -> $KDLT_CACHE -> enabled; KDLT_CACHE=0 kills the
+        # whole subsystem (cache AND coalescing) -- the exact legacy path.
+        self.cache = (
+            cache_lib.ResponseCache(
+                self.registry, ttl_s=cache_ttl_s, max_mb=cache_max_mb
+            )
+            if cache_lib.cache_enabled(cache)
+            else None
+        )
+        self._singleflight = cache_lib.SingleFlight()
         # Multi-replica upstream pool (serving.upstream): replica list from
         # the serving host, per-replica health + breaker, hedging policy.
         # With a single replica this degrades to exactly the PR 2 posture
@@ -568,6 +587,10 @@ class Gateway:
                     continue  # the caller accounts the winner's outcome
                 if lexc is not None or (lr is not None and lr.status_code >= 500):
                     pool.record_failure(lrep)
+                    if lr is not None and lr.headers.get(
+                        protocol.STALLED_HEADER
+                    ):
+                        pool.mark_stalled(lrep)  # declared stall: out now
                     if lrep not in tried:
                         tried.append(lrep)  # a known-bad failover target
             if hedge is not None and rep is hedge and pool.m_hedge_won is not None:
@@ -719,6 +742,14 @@ class Gateway:
             # their merits.
             if r.status_code >= 500:
                 pool.record_failure(replica)
+                if r.headers.get(protocol.STALLED_HEADER):
+                    # A DECLARED dispatch stall (the replica's watchdog
+                    # fired; only a restart recovers it) is not transient
+                    # overload: take the replica out of rotation NOW
+                    # instead of feeding it UNHEALTHY_AFTER more requests
+                    # -- a stalled cross-host leader would otherwise keep
+                    # stranding every coalesced flight that dials it.
+                    pool.mark_stalled(replica)
             else:
                 pool.record_success(replica)
             if r.status_code != 503:
@@ -742,6 +773,15 @@ class Gateway:
             tried.remove(replica)  # the backoff retry re-targets this replica
         if r.status_code != 200:
             raise self._status_error(r)
+        if self.cache is not None:
+            # Learn the serving artifact's identity from the response: a
+            # CHANGED hash is a hot reload with different bytes, which
+            # drops that model's cached entries (a byte-identical
+            # re-export under a higher version keeps them).
+            self.cache.note_artifact_hash(
+                model or self.model,
+                r.headers.get(protocol.ARTIFACT_HASH_HEADER, ""),
+            )
         try:
             logits, labels = protocol.decode_predict_response(
                 r.content, r.headers.get("Content-Type", "")
@@ -869,6 +909,19 @@ class Gateway:
             return (
                 200, json.dumps(self.handle_slo()).encode(), "application/json"
             )
+        if path == "/debug/cache":
+            # The response cache's operator surface: sizing, hit ratio,
+            # per-model residency, resolved artifact hashes, and the
+            # singleflight's live flight count.
+            if self.cache is None:
+                payload: dict = {"enabled": False}
+            else:
+                payload = {
+                    "enabled": True,
+                    **self.cache.stats(),
+                    **self._singleflight.stats(),
+                }
+            return 200, json.dumps(payload).encode(), "application/json"
         if path.startswith("/debug/trace/"):
             return self.handle_trace(path.rsplit("/", 1)[-1])
         return 404, b'{"error": "not found"}', "application/json"
@@ -963,12 +1016,231 @@ class Gateway:
             )
         return None
 
+    def _cache_key(self, routed: str, url: str, salt: str) -> str:
+        """The content hash of one canonicalized single-url request:
+        model name + resolved artifact hash + preprocessing params (from
+        the model's cached contract; a never-discovered spec contributes
+        the empty string, which only splits the very first pre-discovery
+        flight) + the URL payload + the client's cache-bust salt."""
+        default = routed == self.model
+        spec = (
+            self.pool.reference_spec if default
+            else self.pool.reference_specs.get(routed)
+        )
+        params = (
+            "" if spec is None
+            else f"{tuple(spec.input_shape)}|{spec.resize_filter}"
+        )
+        return cache_lib.content_key(
+            routed, self.cache.resolved_hash(routed), params, url, salt=salt
+        )
+
+    def _predict_coalesced(
+        self,
+        body: bytes,
+        req: dict,
+        rid: str,
+        deadline: Deadline | None,
+        rt,
+        model: str | None,
+        routed: str,
+        salt: str,
+    ) -> tuple[int, bytes, str, dict[str, str]]:
+        """The cache + singleflight front door for one single-url request.
+
+        Hit: served straight from the cache -- no admission slot, no
+        preprocessing, no upstream.  Miss: the first arrival leads the
+        flight through the normal path (admission included) and fans its
+        finished response out; concurrent identical arrivals become
+        followers, counted admitted-but-not-dispatched, each waiting under
+        its OWN deadline (a follower's 504 never cancels the leader).
+        Only 200s are cached, so an injected/real upstream failure is
+        never served back; salted (cache-bust) requests coalesce but are
+        never stored.
+        """
+        key = self._cache_key(routed, str(req.get("url", "")), salt)
+        w0 = trace_lib.now_s()
+        cached = self.cache.get(key)
+        if cached is not None:
+            out, ctype = cached
+            self.tracer.record(
+                rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                parent_id=rt.span_id, result="hit",
+            )
+            return 200, out, ctype, {cache_lib.CACHE_STATUS_HEADER: "hit"}
+        flight, leader = self._singleflight.begin(key)
+        if not leader:
+            self.cache.count_coalesced()
+            # Admitted-but-not-dispatched: the follower IS served (via the
+            # leader's flight) without consuming a concurrency slot.
+            self.admission.count_coalesced(routed)
+            timeout = (
+                deadline.remaining_s() if deadline is not None
+                else PREDICT_TIMEOUT_S + 10.0
+            )
+            try:
+                status, out, ctype, extra = flight.wait(max(0.0, timeout))
+            except cache_lib.FlightTimeout:
+                # This waiter's own budget expired; the leader flies on for
+                # the others.
+                self._m_errors.inc()
+                self.admission.count_shed("deadline_exhausted")
+                self.tracer.record(
+                    rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                    parent_id=rt.span_id, result="coalesced", outcome="timeout",
+                )
+                return 504, json.dumps(
+                    {"error": "deadline budget exhausted waiting on the "
+                     "coalesced upstream flight"}
+                ).encode(), "application/json", {
+                    cache_lib.CACHE_STATUS_HEADER: "coalesced"
+                }
+            except BaseException as e:  # noqa: BLE001 - leader died unmapped
+                self._m_errors.inc()
+                self.tracer.record(
+                    rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                    parent_id=rt.span_id, result="coalesced",
+                    error=str(e)[:120],
+                )
+                return 502, json.dumps(
+                    {"error": f"coalesced flight failed: {e}"}
+                ).encode(), "application/json", {
+                    cache_lib.CACHE_STATUS_HEADER: "coalesced"
+                }
+            if status >= 400:
+                self._m_errors.inc()  # every follower answers its own client
+            self.tracer.record(
+                rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                parent_id=rt.span_id, result="coalesced", status=status,
+            )
+            return status, out, ctype, {
+                **extra, cache_lib.CACHE_STATUS_HEADER: "coalesced"
+            }
+        # Leader: record the miss decision as its own (short) span, then
+        # run the normal path -- its sub-spans (admission, preprocess,
+        # upstream attempts) follow in this same trace.
+        self.cache.count_miss()
+        self.tracer.record(
+            rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+            parent_id=rt.span_id, result="miss",
+        )
+        try:
+            status, out, ctype, extra, _n = self._predict_response(
+                body, req, rid, deadline, rt, model, routed
+            )
+        except BaseException as e:
+            # _predict_response maps every Exception; only process-fatal
+            # escapes land here.  Fail the flight so followers never hang.
+            self._singleflight.finish(key, flight)
+            flight.fail(e)
+            raise
+        if status == 200 and not salt:
+            # Store BEFORE detaching the flight: an arrival in between
+            # hits the cache instead of starting a duplicate flight.
+            # Salted requests are deliberate cache opt-outs: they
+            # coalesce (same salt = same stampede) but are never stored.
+            # The key is RE-canonicalized: this flight may just have
+            # learned the model's artifact hash / contract (the first
+            # request of a model, or the first after a reload), and the
+            # entry must live under the key every future lookup computes.
+            self.cache.put(
+                self._cache_key(routed, str(req.get("url", "")), salt),
+                out, ctype, routed, self.cache.resolved_hash(routed),
+            )
+        self._singleflight.finish(key, flight)
+        flight.resolve((status, out, ctype, extra))
+        return status, out, ctype, {
+            **extra, cache_lib.CACHE_STATUS_HEADER: "miss"
+        }
+
+    def _predict_response(
+        self,
+        body: bytes,
+        req: dict | None,
+        rid: str,
+        deadline: Deadline | None,
+        rt,
+        model: str | None,
+        routed: str,
+    ) -> tuple[int, bytes, str, dict[str, str], int]:
+        """The admission -> parse -> preprocess -> upstream core of one
+        /predict, every failure mapped to its client-facing response;
+        returns (status, body, content_type, extra_headers, n_urls).
+
+        Called once per upstream flight: cache hits never reach it, and
+        coalesced followers receive its return tuple through the flight
+        instead of calling it.  ``req`` is the already-parsed body when
+        the cache front door ran (None re-parses here so bad JSON keeps
+        its 400 mapping AFTER admission, the historical precedence).
+        """
+        ticket = None
+        n_urls = 1
+        try:
+            try:
+                with rt.span("gateway.admission"):
+                    ticket = self.admission.admit(deadline, model=routed)
+            except Shed as e:
+                self._m_errors.inc()
+                return e.http_status, json.dumps(
+                    {"error": str(e), "shed_reason": e.reason}
+                ).encode(), "application/json", e.headers(), n_urls
+            if req is None:
+                req = json.loads(body)
+            if "urls" in req:  # batch extension; {"url": ...} is the
+                # reference's schema (reference test.py:15) and unchanged
+                urls = list(req["urls"])
+                n_urls = len(urls)
+                preds = self.apply_model_batch(
+                    urls, rid, deadline, trace=rt, model=model
+                )
+                return 200, json.dumps(
+                    {"predictions": preds}
+                ).encode(), "application/json", {}, n_urls
+            scores = self.apply_model(
+                req["url"], rid, deadline, trace=rt, model=model
+            )
+            return 200, json.dumps(scores).encode(), "application/json", {}, n_urls
+        except UpstreamError as e:
+            self._m_errors.inc()
+            if ticket is not None and e.http_status == 503:
+                ticket.mark_overloaded()  # AIMD: the tier below is saturated
+            return e.http_status, json.dumps(
+                {"error": str(e)}
+            ).encode(), "application/json", retry_after_headers(
+                e.retry_after_s
+            ), n_urls
+        except (QueueFull, BatcherClosed, UpstreamStall) as e:
+            # Transient server-side conditions from the upstream
+            # micro-batcher (overload, shutdown race, hung upstream): a
+            # retryable 503, exactly like the model tier's own mapping --
+            # NOT a 400, which clients would treat as a permanent error.
+            # (UpstreamStall is typed precisely so this clause does not
+            # have to catch TimeoutError, which would also swallow
+            # client-side image-fetch timeouts on Python >= 3.11.)
+            self._m_errors.inc()
+            if ticket is not None:
+                ticket.mark_overloaded()
+            return 503, json.dumps(
+                {"error": f"upstream unavailable: {e}"}
+            ).encode(), "application/json", retry_after_headers(0.05), n_urls
+        except Exception as e:
+            # Bad JSON, missing "url", unfetchable/undecodable image:
+            # genuinely the caller's fault.
+            self._m_errors.inc()
+            return 400, json.dumps(
+                {"error": str(e)}
+            ).encode(), "application/json", {}, n_urls
+        finally:
+            if ticket is not None:
+                ticket.release()
+
     def handle_predict(
         self,
         body: bytes,
         request_id: str | None = None,
         deadline: Deadline | None = None,
         model: str | None = None,
+        cache_bust: str | None = None,
     ) -> tuple[int, bytes, str, dict[str, str]]:
         """POST /predict body -> (status, body, content_type, extra_headers).
 
@@ -981,6 +1253,14 @@ class Gateway:
         headers carry Retry-After on shed/overload responses.  ``model``
         is the transports' resolved route target (resolve_model); None
         keeps the default model and the exact single-model code path.
+        ``cache_bust`` is the client's X-Kdlt-Cache-Bust salt (hashed into
+        the content key; never stored).
+
+        Single-url requests ride the content-addressed cache + singleflight
+        front door (serving.cache) AHEAD of admission; batch requests and
+        the cache-disabled posture take the legacy path unchanged.  Every
+        disposition -- hit, miss, coalesced -- lands in the SAME
+        latency/SLO/trace accounting below, at the same handler boundary.
         """
         t0 = time.perf_counter()
         rid = request_id or ensure_request_id(None)
@@ -1001,66 +1281,32 @@ class Gateway:
         metrics_lib.model_request_counter(self.registry, routed).inc()
         status = 500
         n_urls = 1
-        ticket = None
         try:
             if deadline is None and self.admission.enabled:
                 deadline = Deadline.default()
-            try:
-                with rt.span("gateway.admission"):
-                    ticket = self.admission.admit(deadline, model=routed)
-            except Shed as e:
-                self._m_errors.inc()
-                status = e.http_status
-                return status, json.dumps(
-                    {"error": str(e), "shed_reason": e.reason}
-                ).encode(), "application/json", e.headers()
-            req = json.loads(body)
-            if "urls" in req:  # batch extension; {"url": ...} is the
-                # reference's schema (reference test.py:15) and unchanged
-                urls = list(req["urls"])
-                n_urls = len(urls)
-                preds = self.apply_model_batch(
-                    urls, rid, deadline, trace=rt, model=model
+            req = None
+            if self.cache is not None:
+                try:
+                    parsed = json.loads(body)
+                except Exception:  # noqa: BLE001 - core path maps the 400
+                    parsed = None
+                if (
+                    isinstance(parsed, dict)
+                    and "url" in parsed
+                    and "urls" not in parsed
+                ):
+                    req = parsed
+            if req is not None:
+                status, out, ctype, extra = self._predict_coalesced(
+                    body, req, rid, deadline, rt, model, routed,
+                    str(cache_bust or ""),
                 )
-                status = 200
-                return 200, json.dumps({"predictions": preds}).encode(), "application/json", {}
-            scores = self.apply_model(
-                req["url"], rid, deadline, trace=rt, model=model
-            )
-            status = 200
-            return 200, json.dumps(scores).encode(), "application/json", {}
-        except UpstreamError as e:
-            self._m_errors.inc()
-            status = e.http_status
-            if ticket is not None and status == 503:
-                ticket.mark_overloaded()  # AIMD: the tier below is saturated
-            return e.http_status, json.dumps(
-                {"error": str(e)}
-            ).encode(), "application/json", retry_after_headers(e.retry_after_s)
-        except (QueueFull, BatcherClosed, UpstreamStall) as e:
-            # Transient server-side conditions from the upstream
-            # micro-batcher (overload, shutdown race, hung upstream): a
-            # retryable 503, exactly like the model tier's own mapping --
-            # NOT a 400, which clients would treat as a permanent error.
-            # (UpstreamStall is typed precisely so this clause does not
-            # have to catch TimeoutError, which would also swallow
-            # client-side image-fetch timeouts on Python >= 3.11.)
-            self._m_errors.inc()
-            status = 503
-            if ticket is not None:
-                ticket.mark_overloaded()
-            return 503, json.dumps(
-                {"error": f"upstream unavailable: {e}"}
-            ).encode(), "application/json", retry_after_headers(0.05)
-        except Exception as e:
-            # Bad JSON, missing "url", unfetchable/undecodable image:
-            # genuinely the caller's fault.
-            self._m_errors.inc()
-            status = 400
-            return 400, json.dumps({"error": str(e)}).encode(), "application/json", {}
+            else:
+                status, out, ctype, extra, n_urls = self._predict_response(
+                    body, None, rid, deadline, rt, model, routed
+                )
+            return status, out, ctype, extra
         finally:
-            if ticket is not None:
-                ticket.release()
             dt = time.perf_counter() - t0
             slow = (
                 self._m_latency.count >= 100
@@ -1157,7 +1403,8 @@ class Gateway:
                     else None
                 )
                 status, out, ctype, extra = gw.handle_predict(
-                    self.rfile.read(length), rid, deadline, model=model
+                    self.rfile.read(length), rid, deadline, model=model,
+                    cache_bust=self.headers.get(cache_lib.CACHE_BUST_HEADER),
                 )
                 # Server-Timing-style span summary; handle_predict has
                 # recorded the full trace (root included) by return time.
@@ -1254,6 +1501,12 @@ def main(argv: list[str] | None = None) -> int:
         help="disable the SLO engine (per-model goodput/burn-rate windows, "
         "kdlt_slo_* gauges, /debug/slo); default $KDLT_SLO or enabled",
     )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the content-addressed response cache AND singleflight "
+        "request coalescing (serving.cache); default $KDLT_CACHE or enabled",
+    )
     args = p.parse_args(argv)
     gw = Gateway(
         serving_host=args.serving_host,
@@ -1267,6 +1520,7 @@ def main(argv: list[str] | None = None) -> int:
         hedge_delay_ms=args.hedge_delay_ms,
         probe_interval_s=args.probe_interval_s,
         slo=False if args.no_slo else None,
+        cache=False if args.no_cache else None,
     )
     # SIGTERM -> flip /readyz, shed new work, finish in-flight, then stop;
     # pairs with the k8s terminationGracePeriodSeconds/preStop settings.

@@ -830,7 +830,18 @@ class ModelServer:
                     if server._faults is not None:
                         out = server._faults.corrupt("server.predict", out)
                     status = 200
-                    self._send(200, out, out_ctype)
+                    # The serving artifact's sha256 identity rides every
+                    # success: the gateway's response cache keys validity
+                    # on it (a reload with changed bytes changes the hash
+                    # and drops that model's entries; a byte-identical
+                    # version bump keeps them).
+                    ah = getattr(model, "artifact_hash", None)
+                    self._send(
+                        200, out, out_ctype,
+                        headers=(
+                            {protocol.ARTIFACT_HASH_HEADER: ah} if ah else None
+                        ),
+                    )
                 except faults_lib.InjectedDisconnect:
                     # Injected abrupt connection loss: no response bytes at
                     # all -- the client sees the socket die mid-request,
@@ -859,12 +870,18 @@ class ModelServer:
                     # stuck: retryable for the CLIENT (another replica can
                     # serve it; the gateway's pool fails over on the 503),
                     # terminal for this pod (/healthz is already failing).
+                    # The X-Kdlt-Stalled header distinguishes this from an
+                    # overload 503: the gateway's pool takes the replica
+                    # out of rotation on the FIRST observation.
                     server._m_errors.inc()
                     status = 503
                     self._send_json(
                         503,
                         {"error": f"dispatch stalled: {e}"},
-                        headers=retry_after_headers(1.0),
+                        headers={
+                            **retry_after_headers(1.0),
+                            protocol.STALLED_HEADER: "1",
+                        },
                     )
                 except (QueueFull, FuturesTimeout) as e:  # transient overload
                     server._m_errors.inc()

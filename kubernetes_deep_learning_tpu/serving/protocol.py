@@ -32,6 +32,28 @@ JSON_CONTENT_TYPE = "application/json"
 # import the gateway to spell it.
 MODEL_HEADER = "X-Kdlt-Model"
 
+# Response-cache wire surface (serving.cache).  Request: a client salts the
+# gateway's content hash with X-Kdlt-Cache-Bust to deliberately opt a load
+# test out of the cache (identical salts still coalesce).  Response: the
+# gateway stamps every /predict answer with its cache disposition
+# (hit | miss | coalesced) so clients and load tools can account for it.
+CACHE_BUST_HEADER = "X-Kdlt-Cache-Bust"
+CACHE_STATUS_HEADER = "X-Kdlt-Cache"
+
+# The model tier stamps every 200 :predict response with the serving
+# artifact's sha256 identity (serving.registry.artifact_hash).  The
+# gateway's response cache keys validity on it: a hot reload that changes
+# the bytes changes the hash and drops that model's entries, while a
+# version bump with identical bytes keeps them.
+ARTIFACT_HASH_HEADER = "X-Kdlt-Artifact-Hash"
+
+# A model-tier 503 carrying this header declares a terminal dispatch
+# stall (the engine watchdog fired: /healthz is failing, only a restart
+# recovers).  The gateway's upstream pool takes the replica out of
+# rotation IMMEDIATELY on seeing it -- unlike an overload 503, which is
+# transient evidence that takes consecutive failures to act on.
+STALLED_HEADER = "X-Kdlt-Stalled"
+
 
 def encode_tensor(arr: np.ndarray) -> dict[str, Any]:
     arr = np.ascontiguousarray(arr)

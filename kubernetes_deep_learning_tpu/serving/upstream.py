@@ -215,6 +215,22 @@ class UpstreamPool:
                 replica.set_healthy(True)
         replica.breaker.record_success()
 
+    def mark_stalled(self, replica: UpstreamReplica) -> None:
+        """A replica answered with a DECLARED dispatch stall (the
+        X-Kdlt-Stalled 503: its engine watchdog fired and only a restart
+        recovers it).  Unlike an overload 503 -- transient evidence that
+        takes UNHEALTHY_AFTER consecutive failures to act on -- a declared
+        stall takes the replica out of rotation immediately, so new
+        requests (and every waiter of a coalesced flight) fail over on
+        the FIRST observation instead of feeding the wedged replica.
+        The /healthz prober rejoins it once the restarted pod answers 200
+        (the stalled process fails its own /healthz, so no flapping)."""
+        with self._lock:
+            replica.consecutive_failures = max(
+                replica.consecutive_failures, self._unhealthy_after
+            )
+            replica.set_healthy(False)
+
     def mark_spec_mismatch(self, replica: UpstreamReplica) -> None:
         """Route around a replica serving a different model contract.  Its
         cached (mismatching) spec is kept: only a health-state rejoin

@@ -77,9 +77,14 @@ class GatewayWSGI:
                     if self.gateway.admission.enabled
                     else None
                 )
+                from kubernetes_deep_learning_tpu.serving.cache import (
+                    WSGI_CACHE_BUST_KEY,
+                )
+
                 code, body, ctype, extra = self.gateway.handle_predict(
                     environ["wsgi.input"].read(length), rid, deadline,
                     model=model,
+                    cache_bust=environ.get(WSGI_CACHE_BUST_KEY),
                 )
                 # Same span-summary header as the threaded transport.
                 summary = self.gateway.tracer.summary(rid)

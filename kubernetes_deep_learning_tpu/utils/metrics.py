@@ -481,6 +481,66 @@ def upstream_pool_metrics(registry: "Registry") -> dict:
     }
 
 
+# Gateway response cache + singleflight coalescing (serving.cache).  Every
+# way an entry can leave the cache, as the bounded ``reason`` label on
+# kdlt_cache_evictions_total; minted HERE and nowhere else
+# (tools/check_metrics.py confines the kdlt_cache_ prefix and the reason
+# label to this module).
+CACHE_EVICTION_REASONS = (
+    ("lru", "evicted to fit the KDLT_CACHE_MAX_MB byte budget"),
+    ("ttl", "expired past KDLT_CACHE_TTL_S"),
+    ("reload", "dropped because the model's artifact hash changed (hot "
+               "reload with different bytes)"),
+)
+
+
+def cache_metrics(registry: "Registry") -> dict:
+    """The gateway-tier response-cache series (kdlt_cache_*).
+
+    Centralized like the helpers above so the cache, /debug/cache, and
+    bench.py --cache-ab key one set of names.  ``hits`` never touched
+    admission or the upstream; ``coalesced`` rode another request's
+    flight (admitted-but-not-dispatched); ``misses`` paid the full path.
+    """
+    return {
+        "hits": registry.counter(
+            "kdlt_cache_hits_total",
+            "requests served from the response cache (no admission slot, "
+            "no upstream call, no device work)",
+        ),
+        "misses": registry.counter(
+            "kdlt_cache_misses_total",
+            "cacheable requests that missed and led their own upstream flight",
+        ),
+        "coalesced": registry.counter(
+            "kdlt_cache_coalesced_total",
+            "requests coalesced onto another identical request's in-flight "
+            "upstream call (singleflight followers)",
+        ),
+        "bytes": registry.counter(
+            "kdlt_cache_bytes_total",
+            "response bytes inserted into the cache",
+        ),
+        "resident": registry.gauge(
+            "kdlt_cache_resident_bytes",
+            "response bytes currently held by the cache",
+        ),
+        "entries": registry.gauge(
+            "kdlt_cache_entries", "entries currently held by the cache"
+        ),
+        "hit_ratio": registry.gauge(
+            "kdlt_cache_hit_ratio",
+            "lifetime hits / (hits + misses) of the response cache",
+        ),
+        "evictions": {
+            reason: registry.with_labels(reason=reason).counter(
+                "kdlt_cache_evictions_total", help
+            )
+            for reason, help in CACHE_EVICTION_REASONS
+        },
+    }
+
+
 def replica_healthy_gauge(registry: "Registry", host: str) -> "Gauge":
     """Per-replica health gauge (1 = routable, 0 = routed around)."""
     return registry.with_labels(replica=host).gauge(

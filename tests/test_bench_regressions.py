@@ -130,6 +130,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--batcher-sweep", "5"], "batcher_sweep"),
         (["--overload-ab", "6"], "overload_ab"),
         (["--chaos-ab", "6"], "chaos_ab"),
+        (["--cache-ab", "6"], "cache_ab"),
         (["--crosshost-ab", "30"], "crosshost_ab"),
         (["--obs-overhead-ab", "5"], "obs_overhead_ab"),
     ):
@@ -170,7 +171,7 @@ def test_dry_run_chaos_ab_echoes_the_fault_tolerance_config():
     proc = subprocess.run(
         [sys.executable, _BENCH, "--chaos-ab", "6", "--dry-run",
          "--chaos-hedge-ms", "80", "--chaos-probe-s", "0.25",
-         "--chaos-seed", "7"],
+         "--chaos-seed", "7", "--chaos-mode", "stall"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
     )
     assert proc.returncode == 0, proc.stderr.decode()[-500:]
@@ -181,6 +182,34 @@ def test_dry_run_chaos_ab_echoes_the_fault_tolerance_config():
     assert out["chaos"]["probe_s"] == 0.25
     assert out["chaos"]["seed"] == 7
     assert out["chaos"]["deadline_ms"] == 2000.0
+    # The cross-host leader arm (ISSUE 8 satellite): the stall mode must
+    # round-trip the CLI.
+    assert out["chaos"]["mode"] == "stall"
+
+
+def test_dry_run_cache_ab_echoes_the_cache_config():
+    # The --cache-ab invocation surface (the gateway cache + singleflight
+    # acceptance harness, ISSUE 8) must keep parsing and echo its resolved
+    # knobs without importing jax, binding ports, or spawning servers.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--cache-ab", "6", "--dry-run",
+         "--cache-zipf-alpha", "1.3", "--cache-universe", "32",
+         "--cache-rate-rps", "80", "--cache-probe-n", "12",
+         "--cache-seed", "5"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "cache_ab"
+    assert out["cache"]["duration_s"] == 6.0
+    assert out["cache"]["zipf_alpha"] == 1.3
+    assert out["cache"]["universe"] == 32
+    assert out["cache"]["rate_rps"] == 80.0
+    assert out["cache"]["probe_n"] == 12
+    assert out["cache"]["seed"] == 5
+    assert out["cache"]["device_ms"] == 50.0
+    assert out["cache"]["deadline_ms"] == 800.0
 
 
 def test_dry_run_crosshost_ab_echoes_the_pipeline_config():
@@ -308,6 +337,25 @@ def test_multimodel_ab_weighted_beats_fifo_on_worst_model_goodput():
         w["models"]["mm-heavy"]["goodput_frac"]
         >= 0.8 * f["models"]["mm-heavy"]["goodput_frac"]
     )
+
+
+@pytest.mark.slow
+def test_cache_ab_hit_ratio_goodput_and_singleflight_proof():
+    """ISSUE 8's acceptance bar (slow: two ~4s open-loop HTTP arms): on a
+    Zipf(1.1) workload at ~2x stub-tier capacity, the cache-on arm holds
+    hit_ratio >= 0.5 and beats the cache-off arm's in-deadline goodput;
+    a probe of N identical concurrent requests produces EXACTLY ONE
+    upstream dispatch (singleflight), and a fresh URL's miss-path
+    response is bit-identical to the cache-off arm's."""
+    bench = _bench_module()
+    out, rc = bench.bench_cache_ab(duration_s=4.0)
+    assert rc == 0, out
+    assert out["hit_ratio"] >= 0.5, out
+    assert out["vs_baseline"] > 1.0, out
+    assert out["singleflight_upstream_dispatches"] == 1, out
+    assert out["miss_bit_identical"] is True, out
+    on = out["arms"]["cache_on"]
+    assert on["hits"] > 0 and on["misses"] > 0
 
 
 @pytest.mark.slow

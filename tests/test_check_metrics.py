@@ -112,6 +112,27 @@ def test_lint_flags_exemplar_on_non_histogram_mutation():
     ) == []
 
 
+def test_lint_flags_cache_series_minted_outside_central_module():
+    # The response cache's series (ISSUE 8): kdlt_cache_* mints are
+    # confined to utils/metrics.py exactly like kdlt_slo_*.
+    src = 'reg.counter("kdlt_cache_hits_total", "rogue mint")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "kdlt_cache_" in v and "central" in v
+    assert check_metrics.lint_source(src, _METRICS_PATH) == []
+
+
+def test_lint_flags_cache_eviction_reason_label_outside_central():
+    # The bounded ``reason`` label (cache eviction reasons) may only be
+    # attached by the central helpers.
+    (v,) = check_metrics.lint_source(
+        'reg.with_labels(reason="lru")\n', "fake.py"
+    )
+    assert "reason" in v and "central" in v
+    assert check_metrics.lint_source(
+        'reg.with_labels(reason="lru")\n', _METRICS_PATH
+    ) == []
+
+
 def test_lint_flags_bounded_window_and_class_labels_outside_central():
     (v,) = check_metrics.lint_source(
         'reg.with_labels(window="5m")\n', "fake.py"

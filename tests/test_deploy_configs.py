@@ -414,6 +414,48 @@ def test_multimodel_scheduler_and_default_model_wiring():
         )
 
 
+def test_gateway_cache_envs_agree_across_k8s_and_compose():
+    """The response-cache wiring (ISSUE 8): the gateway carries the
+    KDLT_CACHE_* envs in BOTH deploy targets with values the code accepts,
+    and the two topologies agree -- a compose stack used to rehearse a
+    k8s rollout must exhibit the same caching behavior (hit ratios,
+    staleness window, memory budget)."""
+    from kubernetes_deep_learning_tpu.serving.cache import (
+        CACHE_ENV,
+        MAX_MB_ENV,
+        TTL_ENV,
+        cache_enabled,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    gw_container = gw_dep["spec"]["template"]["spec"]["containers"][0]
+    k8s_env = {
+        e["name"]: str(e.get("value", "")) for e in gw_container["env"]
+    }
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    compose_env = {
+        k: str(v)
+        for k, v in compose["services"]["gateway"]["environment"].items()
+    }
+    for var in (CACHE_ENV, TTL_ENV, MAX_MB_ENV):
+        assert var in k8s_env, f"k8s gateway must set {var}"
+        assert var in compose_env, f"compose gateway must set {var}"
+        assert k8s_env[var] == compose_env[var], (
+            f"{var} disagrees: k8s={k8s_env[var]!r} "
+            f"compose={compose_env[var]!r}"
+        )
+    # The values must parse as a usable configuration: cache enabled, a
+    # positive staleness bound, a positive byte budget.
+    os.environ[CACHE_ENV] = k8s_env[CACHE_ENV]
+    try:
+        assert cache_enabled() is True, "deploys must not ship the kill switch"
+    finally:
+        del os.environ[CACHE_ENV]
+    assert float(k8s_env[TTL_ENV]) > 0, "TTL wired off"
+    assert float(k8s_env[MAX_MB_ENV]) > 0, "byte budget wired off"
+
+
 def test_model_server_hpa_scales_on_minted_serving_signals():
     """The model-tier HPA (ROADMAP multi-model gap #4) must scale on metric
     names the serving path actually mints: every metric named in the HPA

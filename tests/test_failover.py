@@ -102,6 +102,28 @@ def test_pool_blind_mode_ignores_health():
     assert not pool.has_healthy_candidate(exclude=[b])
 
 
+def test_pool_mark_stalled_takes_replica_out_on_first_observation():
+    """ISSUE 8 satellite (ROADMAP cross-host gap #1): a DECLARED dispatch
+    stall (the model tier's X-Kdlt-Stalled 503) is terminal until restart,
+    so one observation suffices -- unlike ordinary failures, which take
+    UNHEALTHY_AFTER consecutive ones."""
+    pool = UpstreamPool(["h1:1", "h2:2"], failover=True, probe_interval_s=0)
+    a, b = pool.replicas
+    # One ORDINARY failure does not unhealth a replica...
+    pool.record_failure(a)
+    assert a.healthy
+    pool.record_success(a)
+    # ...but one declared stall does, immediately.
+    pool.mark_stalled(a)
+    assert not a.healthy
+    assert pool.choose() is b and pool.choose() is b
+    # The stall mark is sticky against the consecutive-failure reset
+    # logic: only an actual health-probe rejoin brings it back.
+    assert not pool.has_healthy_candidate(exclude=[b])
+    pool.record_success(a)  # e.g. the prober's rejoin path
+    assert a.healthy
+
+
 def test_pool_parse_hosts():
     from kubernetes_deep_learning_tpu.serving.upstream import parse_hosts
 
@@ -474,3 +496,33 @@ def test_chaos_ab_failover_holds_goodput_and_baseline_collapses():
     assert on["recovery_s"] <= out["probe_interval_s"] + 0.5
     assert off["post_kill_in_deadline_rate"] < 0.85
     assert on["failover_total"] >= 1
+
+
+@pytest.mark.slow
+def test_chaos_ab_stall_leader_arm_marks_out_on_first_observation():
+    """ISSUE 8 satellite acceptance (slow: two ~3s open-loop arms): a
+    dispatch-stalled replica -- the cross-host leader failure mode, fast
+    X-Kdlt-Stalled 503s with /healthz failing -- is fed at most a couple
+    requests once marked out (health-aware pool), while blind round-robin
+    keeps sending it its full traffic share."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import bench
+
+    out, rc = bench.bench_chaos_ab(
+        duration_s=3.0, rate_rps=20.0, device_ms=20.0,
+        deadline_ms=2000.0, hedge_delay_ms=100.0, probe_interval_s=0.5,
+        seed=0, mode="stall",
+    )
+    on = out["arms"]["failover_on"]
+    off = out["arms"]["failover_off"]
+    assert rc == 0, out
+    assert on["post_kill_in_deadline_rate"] >= 0.95
+    assert on["post_kill_victim_requests"] <= 3, (
+        "the pool kept feeding the stalled replica"
+    )
+    assert off["post_kill_victim_requests"] >= 0.25 * off["post_kill_requests"]

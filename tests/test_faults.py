@@ -243,3 +243,82 @@ def test_server_latency_fault_delays_requests(tmp_path, monkeypatch):
         assert time.perf_counter() - t0 >= 0.07
     finally:
         server.shutdown()
+
+
+# --- cache x fault-injection (ISSUE 8 satellite) ----------------------------
+# The real gateway.upstream fault point firing under the cache+singleflight
+# front door: an injected upstream failure must surface to the client AND
+# never be served back from the response cache once the fault clears.
+
+
+def _gateway_stack(tmp_path, name):
+    import os
+    import threading
+    from functools import partial
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+
+    spec, server = _stub_server(name, tmp_path)
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, host="127.0.0.1",
+    )
+    gw.start()
+
+    class Quiet(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    img_dir = tmp_path / "img"
+    img_dir.mkdir()
+    rng = np.random.default_rng(0)
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(str(img_dir), "img.png"))
+    httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(Quiet, directory=str(img_dir))
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    img_url = f"http://127.0.0.1:{httpd.server_address[1]}/img.png"
+    return spec, server, gw, httpd, img_url
+
+
+def test_gateway_injected_upstream_error_is_never_cached(
+    tmp_path, monkeypatch
+):
+    import requests
+
+    # The gateway's own injector fires at gateway.upstream (rate 1.0, no
+    # failover so the injected failure surfaces instead of retrying).
+    monkeypatch.setenv(faults.FAULTS_ENV, "gateway.upstream:error:1.0")
+    monkeypatch.setenv("KDLT_FAILOVER", "0")
+    spec, server, gw, httpd, img_url = _gateway_stack(
+        tmp_path, "faults-cache-gw"
+    )
+    try:
+        url = f"http://127.0.0.1:{gw.port}/predict"
+        r1 = requests.post(url, json={"url": img_url}, timeout=10)
+        assert r1.status_code in (502, 503)
+        assert r1.headers.get(protocol.CACHE_STATUS_HEADER) == "miss"
+        # The failure was NOT stored: the cache holds nothing.
+        dbg = requests.get(
+            f"http://127.0.0.1:{gw.port}/debug/cache", timeout=5
+        ).json()
+        assert dbg["entries"] == 0
+        # Fault cleared: the same URL re-dispatches upstream and succeeds
+        # -- a cached error here would be a silent availability bug.
+        gw._faults = None
+        r2 = requests.post(url, json={"url": img_url}, timeout=10)
+        assert r2.status_code == 200
+        assert r2.headers.get(protocol.CACHE_STATUS_HEADER) == "miss"
+        r3 = requests.post(url, json={"url": img_url}, timeout=10)
+        assert r3.status_code == 200
+        assert r3.headers.get(protocol.CACHE_STATUS_HEADER) == "hit"
+        assert r3.json() == r2.json()
+    finally:
+        gw.shutdown()
+        server.shutdown()
+        httpd.shutdown()

@@ -225,6 +225,7 @@ def test_gateway_retries_transient_503(stack, monkeypatch):
     class Fake503:
         status_code = 503
         text = "overloaded"
+        headers: dict = {}
 
     def flaky_post(*args, **kwargs):
         calls["n"] += 1
@@ -318,9 +319,12 @@ def test_request_id_traced_across_tiers(stack, capsys):
     gateway.request_log = True
     server.request_log = True
     try:
+        # A fresh URL identity: a response-cache hit would (correctly)
+        # never reach the model tier, and this test asserts the FULL
+        # cross-tier propagation path.
         r = requests.post(
             f"http://localhost:{gateway.port}/predict",
-            json={"url": image_url},
+            json={"url": image_url + "?trace-propagation=1"},
             headers={REQUEST_ID_HEADER: rid},
             timeout=60,
         )

@@ -200,6 +200,10 @@ def traced_stack(tmp_path_factory):
     gateway = Gateway(
         serving_host=f"127.0.0.1:{server.port}", model=spec.name, port=0,
         host="127.0.0.1",
+        # The response cache would serve repeat fixture URLs without an
+        # upstream hop at all; these tests trace the FULL path (the cached
+        # path's gateway.cache span is covered by test_cache.py).
+        cache=False,
     )
     gateway.start()
 

@@ -90,6 +90,31 @@ def test_wsgi_health_metrics_and_errors(wsgi_stack):
     assert "error" in r.json()
 
 
+def test_wsgi_cache_dispositions_and_bust_header(wsgi_stack):
+    """The response cache's wire surface through the WSGI transport: the
+    X-Kdlt-Cache disposition header and the X-Kdlt-Cache-Bust salt behave
+    exactly like the threaded transport (both call the same
+    Gateway.handle_predict)."""
+    from kubernetes_deep_learning_tpu.serving import protocol
+
+    base = wsgi_stack["base"]
+    url = wsgi_stack["image_url"] + "?wsgi-cache=1"
+    r1 = requests.post(base + "/predict", json={"url": url}, timeout=30)
+    assert r1.status_code == 200
+    assert r1.headers[protocol.CACHE_STATUS_HEADER] == "miss"
+    r2 = requests.post(base + "/predict", json={"url": url}, timeout=30)
+    assert r2.status_code == 200
+    assert r2.headers[protocol.CACHE_STATUS_HEADER] == "hit"
+    assert r1.json() == r2.json()
+    r3 = requests.post(
+        base + "/predict", json={"url": url},
+        headers={protocol.CACHE_BUST_HEADER: "wsgi-salt"}, timeout=30,
+    )
+    assert r3.status_code == 200
+    assert r3.headers[protocol.CACHE_STATUS_HEADER] == "miss"
+    assert r3.json() == r2.json()  # the bust path recomputes, same answer
+
+
 def test_oversize_body_rejected_without_read():
     """A declared multi-GB body is refused at the Content-Length check,
     before any byte of the body is read (ADVICE r1: memory exhaustion)."""

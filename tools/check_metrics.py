@@ -49,12 +49,13 @@ MINT_METHODS = {"counter", "gauge", "histogram"}
 METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # Labels whose value sets are bounded by construction inside utils/metrics.py
 # (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
-# the trace retention classes) -- attaching them anywhere else escapes the
-# bound.
-CENTRAL_LABELS = {"model", "window", "class"}
+# the trace retention classes; reason: the cache eviction reasons) --
+# attaching them anywhere else escapes the bound.
+CENTRAL_LABELS = {"model", "window", "class", "reason"}
 # Series prefixes whose minting is confined to utils/metrics.py even beyond
-# the general helper conventions.
-CENTRAL_PREFIXES = ("kdlt_slo_",)
+# the general helper conventions (the SLO gauge matrix and the response
+# cache's series: both carry bounded labels a stray mint would escape).
+CENTRAL_PREFIXES = ("kdlt_slo_", "kdlt_cache_")
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
 SKIP_PARTS = {"tfs_gen", "__pycache__"}
 
@@ -190,8 +191,9 @@ def lint_source(src: str, rel: str) -> list[str]:
             ):
                 violations.append(
                     f"{rel}:{node.lineno}: {head!r} minted outside "
-                    "utils/metrics.py; kdlt_slo_* series are minted only by "
-                    "the central SLO helpers (bounded model x window matrix)"
+                    "utils/metrics.py; kdlt_slo_*/kdlt_cache_* series are "
+                    "minted only by the central helpers (bounded label sets "
+                    "by construction)"
                 )
     return violations
 
