@@ -2245,6 +2245,236 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
     return out, 0 if ok else 1
 
 
+def bench_quant_ab(reps=3, size=32, buckets=(1, 2), calib_images=8,
+                   percentile=None, seed=0, min_size=4096, tol=None):
+    """f32 vs int8-weight-only vs int8-w8a8 on the REAL engine path.
+
+    Three InferenceEngines serve the same random-init xception weights at
+    ``(size, size, 3)`` input over the same bucket ladder: the float
+    artifact, the weight-only quantized one, and the calibrated w8a8 one
+    (whose warmup runs the production tolerance gate -- its measured
+    drift/top-1 land in the record).  Per bucket the arm reports measured
+    img/s, mfu_pct (None off-TPU: the peak table keys on device kind),
+    and w8/w8a8 logit drift + top-1 agreement against the f32 engine on a
+    seeded golden fixture batch.
+
+    The throughput GATE runs on roofline proxy numbers modeled with v5e
+    constants (weight-bytes / HBM bandwidth vs FLOPs / scheme peak, int8
+    matmul peak = 2x bf16 -- the MXU's 2x int8 path): XLA:CPU has no
+    vectorized s8xs8 conv, so measured CPU img/s for w8a8 is reported
+    honestly but cannot stand in for the device.  rc=0 iff the w8a8 arm's
+    proxy img/s at the SMALLEST bucket is >= 1.2x the f32 arm's AND
+    top-1 agreement >= 0.99 AND relative max-abs drift <= KDLT_QUANT_TOL
+    AND the engine's own warmup gate accepted the calibrated artifact.
+    """
+    from kubernetes_deep_learning_tpu.export.artifact import ModelArtifact
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+    from kubernetes_deep_learning_tpu.runtime import InferenceEngine
+    from kubernetes_deep_learning_tpu.runtime import flops as flops_lib
+
+    import jax
+
+    if percentile is None:
+        percentile = quant_lib.DEFAULT_CALIB_PERCENTILE
+    tol = quant_lib.resolve_quant_tol(tol)
+    buckets = tuple(sorted(buckets))
+    spec = register_spec(
+        ModelSpec(
+            name="quant-ab",
+            family="xception",
+            input_shape=(size, size, 3),
+            labels=tuple(f"c{i}" for i in range(10)),
+            preprocessing="tf",
+        )
+    )
+    log(
+        f"quant A/B: xception @{size}x{size}, buckets {buckets}, "
+        f"{reps} reps/bucket, calib {calib_images} imgs @p{percentile:g}, "
+        f"min_size {min_size}, tol {tol:g}"
+    )
+    variables = jax.tree_util.tree_map(np.asarray, init_variables(spec, seed=1))
+    qvars = quant_lib.quantize_variables(variables, min_size=min_size)
+    rng = np.random.default_rng(seed)
+    calib = rng.integers(
+        0, 256, size=(calib_images, *spec.input_shape), dtype=np.uint8
+    )
+    scales = quant_lib.calibrate_activation_scales(
+        spec, variables, qvars, calib, percentile=percentile
+    )
+    w8a8_vars = {
+        **qvars,
+        "params": quant_lib.attach_activation_scales(qvars["params"], scales),
+    }
+    # float32 compute on every arm: the comparison is quantization noise,
+    # not bf16 noise.
+    meta = {"compute_dtype": "float32"}
+    arms_spec = {
+        "f32": ModelArtifact(spec, variables, None, dict(meta)),
+        "w8": ModelArtifact(
+            spec, qvars, None, {**meta, "quantization": quant_lib.SCHEME}
+        ),
+        "w8a8": ModelArtifact(
+            spec, w8a8_vars, None,
+            {**meta, "quantization": quant_lib.SCHEME_W8A8},
+        ),
+    }
+
+    # Roofline proxy constants (v5e datasheet): the modeled device the
+    # CPU run cannot be.
+    proxy_bw_gbps = 819.0
+    proxy_peak_tflops = flops_lib.PEAK_TFLOPS_BY_KIND["v5e"]["bfloat16"]
+
+    def weight_bytes(tree) -> int:
+        total = 0
+
+        def walk(t):
+            nonlocal total
+            if isinstance(t, dict):
+                for v in t.values():
+                    walk(v)
+            elif hasattr(t, "nbytes"):
+                total += int(t.nbytes)
+
+        walk(tree)
+        return total
+
+    engines: dict[str, InferenceEngine] = {}
+    fixtures = {
+        b: rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8)
+        for b in buckets
+    }
+    results: dict[str, dict] = {}
+    golden: dict[str, dict[int, np.ndarray]] = {}
+    for name, artifact in arms_spec.items():
+        t0 = time.perf_counter()
+        eng = InferenceEngine(artifact, buckets=buckets, use_exported=False)
+        warm_s = eng.warmup()
+        engines[name] = eng
+        golden[name] = {
+            b: eng.predict(fixtures[b]) for b in buckets
+        }
+        per_bucket = {}
+        flops_img = eng._flops_per_image(buckets[0])
+        peak = flops_lib.peak_tflops(eng._device, "float32")
+        for b in buckets:
+            x = fixtures[b]
+            eng.predict(x)  # warm the timing path
+            t1 = time.perf_counter()
+            for _ in range(reps):
+                eng.predict(x)
+            dt = (time.perf_counter() - t1) / reps
+            img_s = b / dt
+            mfu = (
+                round(100.0 * img_s * flops_img / (peak * 1e12), 1)
+                if peak and flops_img else None
+            )
+            # Modeled v5e time/batch: weight-bandwidth term vs MXU term
+            # (int8 operands run the 2x path; weight-only still feeds the
+            # MXU floats, so only w8a8 earns the multiplier).
+            wbytes = weight_bytes(arms_spec[name].variables)
+            mult = 2.0 if name == "w8a8" else 1.0
+            t_model = max(
+                wbytes / (proxy_bw_gbps * 1e9),
+                (flops_img or 0.0) * b / (proxy_peak_tflops * 1e12 * mult),
+            )
+            per_bucket[b] = {
+                "img_per_s": round(img_s, 2),
+                "mfu_pct": mfu,
+                "proxy_img_per_s": round(b / t_model, 1) if t_model else None,
+                "weight_bytes": wbytes,
+            }
+        results[name] = {
+            "warmup_s": round(warm_s, 2),
+            "buckets": per_bucket,
+        }
+        log(
+            f"  {name:<4s}: warmup {warm_s:5.1f}s  "
+            + "  ".join(
+                f"b{b}: {per_bucket[b]['img_per_s']:8.2f} img/s "
+                f"(proxy {per_bucket[b]['proxy_img_per_s']})"
+                for b in buckets
+            )
+        )
+
+    drift_table: dict[str, dict[int, dict]] = {}
+    for name in ("w8", "w8a8"):
+        drift_table[name] = {}
+        for b in buckets:
+            a, q = golden["f32"][b], golden[name][b]
+            drift = float(np.abs(a - q).max() / (np.abs(a).max() + 1e-9))
+            top1 = float((a.argmax(-1) == q.argmax(-1)).mean())
+            drift_table[name][b] = {
+                "rel_maxabs_drift": round(drift, 4),
+                "top1_agreement": round(top1, 4),
+            }
+    w8a8_eng = engines["w8a8"]
+    gate_ok = (
+        w8a8_eng.quantization_active == quant_lib.SCHEME_W8A8
+        and not w8a8_eng.quant_gate_failed
+    )
+    b0 = buckets[0]
+    # The golden-fixture check aggregates every bucket's fixture rows (the
+    # gate bar is over the whole fixture, not the friendliest bucket).
+    worst_drift = max(
+        drift_table["w8a8"][b]["rel_maxabs_drift"] for b in buckets
+    )
+    total = sum(buckets)
+    agree = sum(
+        drift_table["w8a8"][b]["top1_agreement"] * b for b in buckets
+    ) / total
+    proxy_speedup = (
+        results["w8a8"]["buckets"][b0]["proxy_img_per_s"]
+        / max(results["f32"]["buckets"][b0]["proxy_img_per_s"], 1e-9)
+    )
+    measured_speedup = (
+        results["w8a8"]["buckets"][b0]["img_per_s"]
+        / max(results["f32"]["buckets"][b0]["img_per_s"], 1e-9)
+    )
+    ok = (
+        gate_ok
+        and proxy_speedup >= 1.2
+        and agree >= quant_lib.GATE_TOP1
+        and worst_drift <= tol
+    )
+    log(
+        f"  w8a8 vs f32 @b{b0}: proxy {proxy_speedup:.2f}x, measured "
+        f"{measured_speedup:.2f}x ({'no int8 fast path on ' + jax.default_backend() if measured_speedup < 1 else 'real'}), "
+        f"top1 {agree:.4f}, worst drift {worst_drift:.4f} (tol {tol:g}), "
+        f"gate {'accepted' if gate_ok else 'REFUSED'}"
+    )
+    out = {
+        "metric": (
+            f"full-int8 quantization A/B (xception @{size}, buckets "
+            f"{list(buckets)}): w8a8 vs f32 img/s on the v5e weight-"
+            f"bandwidth/MXU roofline proxy at the smallest bucket "
+            f"(measured CPU numbers reported alongside; XLA:CPU has no "
+            f"vectorized s8xs8 conv)"
+        ),
+        "value": round(proxy_speedup, 3),
+        "unit": "x proxy img/s (w8a8 / f32, smallest bucket)",
+        "vs_baseline": round(proxy_speedup, 3),
+        "measured_speedup": round(measured_speedup, 3),
+        "top1_agreement": round(agree, 4),
+        "worst_rel_maxabs_drift": round(worst_drift, 4),
+        "tol": tol,
+        "gate_accepted": gate_ok,
+        "gate_drift": round(getattr(w8a8_eng, "quant_gate_drift", -1.0), 4),
+        "gate_top1": round(getattr(w8a8_eng, "quant_gate_top1", -1.0), 4),
+        "calib_images": calib_images,
+        "percentile": percentile,
+        "min_size": min_size,
+        "seed": seed,
+        "arms": results,
+        "drift": {
+            name: {str(b): row for b, row in table.items()}
+            for name, table in drift_table.items()
+        },
+    }
+    return out, 0 if ok else 1
+
+
 def bench_cache_ab(duration_s=6.0, device_ms=50.0, deadline_ms=800.0,
                    rate_rps=60.0, zipf_alpha=1.1, universe=64, probe_n=16,
                    seed=0):
@@ -3047,6 +3277,50 @@ def main() -> int:
         help="light-model offered request rate for --multimodel-ab",
     )
     p.add_argument(
+        "--quant-ab", type=int, default=0, metavar="REPS",
+        help="INSTEAD of the sweep: full-int8 quantization A/B -- f32 vs "
+             "int8-weight-only vs calibrated int8-w8a8 InferenceEngines on "
+             "the same weights, reporting per-bucket img/s, mfu_pct, and "
+             "logit drift/top-1 vs f32, this many timed reps per bucket.  "
+             "The throughput gate runs on the v5e roofline proxy (XLA:CPU "
+             "has no s8xs8 fast path); rc=0 iff w8a8 proxy img/s >= 1.2x "
+             "f32 at the smallest bucket AND top-1 >= 0.99 AND drift <= "
+             "KDLT_QUANT_TOL AND the engine's warmup tolerance gate "
+             "accepted the calibrated artifact",
+    )
+    p.add_argument(
+        "--quant-size", type=int, default=32,
+        help="square input size for --quant-ab (small keeps the CPU int8 "
+             "reference lowering tractable; kernel shapes -- the weight "
+             "bytes that drive the roofline -- are size-independent)",
+    )
+    p.add_argument(
+        "--quant-buckets", default="1,2",
+        help="bucket ladder for --quant-ab",
+    )
+    p.add_argument(
+        "--quant-calib-images", type=int, default=8,
+        help="calibration images for the --quant-ab w8a8 arm",
+    )
+    p.add_argument(
+        "--quant-percentile", type=float, default=0.0,
+        help="calibration percentile clip (0 = the ops.quantize default)",
+    )
+    p.add_argument(
+        "--quant-min-size", type=int, default=4096,
+        help="min kernel elements to quantize (raise on CPU to confine "
+             "the slow int8 reference lowering to the biggest matmuls)",
+    )
+    p.add_argument(
+        "--quant-tol", type=float, default=0.0,
+        help="relative max-abs logit drift bound (0 = $KDLT_QUANT_TOL or "
+             "the default)",
+    )
+    p.add_argument(
+        "--quant-seed", type=int, default=0,
+        help="seed for --quant-ab fixtures and calibration stream",
+    )
+    p.add_argument(
         "--chaos-ab", type=float, default=0, metavar="SECONDS",
         help="INSTEAD of the sweep: serving-path fault-tolerance A/B -- "
              "front two stub model-tier replicas with the real gateway, "
@@ -3217,7 +3491,7 @@ def main() -> int:
         for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
                      "batcher_sweep", "host_saturation", "overload_ab",
                      "chaos_ab", "cache_ab", "trace_breakdown",
-                     "multimodel_ab", "obs_overhead_ab"):
+                     "multimodel_ab", "obs_overhead_ab", "quant_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -3247,6 +3521,16 @@ def main() -> int:
                 "probe_s": args.chaos_probe_s,
                 "seed": args.chaos_seed,
                 "mode": args.chaos_mode,
+            },
+            "quant": {
+                "reps": args.quant_ab,
+                "size": args.quant_size,
+                "buckets": [int(b) for b in args.quant_buckets.split(",")],
+                "calib_images": args.quant_calib_images,
+                "percentile": args.quant_percentile,
+                "min_size": args.quant_min_size,
+                "tol": args.quant_tol,
+                "seed": args.quant_seed,
             },
             "cache": {
                 "duration_s": args.cache_ab,
@@ -3387,6 +3671,20 @@ def main() -> int:
             probe_interval_s=args.chaos_probe_s,
             seed=args.chaos_seed,
             mode=args.chaos_mode,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.quant_ab > 0:
+        out, rc = bench_quant_ab(
+            reps=args.quant_ab,
+            size=args.quant_size,
+            buckets=tuple(int(b) for b in args.quant_buckets.split(",")),
+            calib_images=args.quant_calib_images,
+            percentile=args.quant_percentile or None,
+            seed=args.quant_seed,
+            min_size=args.quant_min_size,
+            tol=args.quant_tol or None,
         )
         print(json.dumps(out), flush=True)
         return rc

@@ -158,6 +158,34 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="jax platform override (e.g. cpu; export itself only traces)",
     )
+    p.add_argument(
+        "--calibrate",
+        type=int,
+        nargs="?",
+        const=0,
+        default=None,
+        help="ALSO write a calibrated int8-w8a8 artifact as the NEXT "
+        "version: run N representative uint8 images (default 32) through "
+        "the float graph, record per-layer activation absmax under the "
+        "percentile clip, and store the static scales next to the _q8 "
+        "weight leaves.  Calibration happens HERE, at artifact build -- "
+        "never at serving time; the engine gates activation with "
+        "KDLT_QUANT_TOL at warmup (GUIDE 9d)",
+    )
+    p.add_argument(
+        "--calibrate-percentile",
+        type=float,
+        default=None,
+        help="percentile clip on |activation| for --calibrate (default "
+        "99.9; 100 = plain absmax)",
+    )
+    p.add_argument(
+        "--calibrate-dir",
+        default=None,
+        help="directory of representative images for --calibrate (default: "
+        "seeded noise; production should calibrate on real traffic samples)",
+    )
+    p.add_argument("--calibrate-seed", type=int, default=0)
     args = p.parse_args(argv)
 
     from kubernetes_deep_learning_tpu.utils.platform import force_platform
@@ -184,6 +212,31 @@ def main(argv: list[str] | None = None) -> int:
         params_dtype=jnp.dtype(args.params_dtype) if args.params_dtype else None,
     )
     print(f"exported {spec.name} -> {directory}")
+    if args.calibrate is not None:
+        # The w8a8 build step (ops.quantize): quantize the just-exported
+        # float version and calibrate activation scales offline, landing
+        # as the next version so the watcher hot-rolls it like any other.
+        from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+        n = args.calibrate or quant_lib.DEFAULT_CALIB_IMAGES
+        calib = quant_lib.representative_images(
+            spec, n, seed=args.calibrate_seed, image_dir=args.calibrate_dir
+        )
+        percentile = (
+            args.calibrate_percentile
+            if args.calibrate_percentile is not None
+            else quant_lib.DEFAULT_CALIB_PERCENTILE
+        )
+        qdir = quant_lib.write_quantized_version(
+            args.output,
+            spec.name,
+            scheme=quant_lib.SCHEME_W8A8,
+            calib_images=calib,
+            percentile=percentile,
+        )
+        print(
+            f"calibrated int8-w8a8 ({n} images, p{percentile:g} clip) -> {qdir}"
+        )
     return 0
 
 

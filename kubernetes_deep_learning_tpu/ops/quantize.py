@@ -1,42 +1,103 @@
-"""int8 weight-only quantization for serving artifacts.
+"""int8 quantization for serving artifacts: weight-only and full w8a8.
 
 The reference's only performance lever is swapping the TF-Serving image for
-the GPU build (reference tf-serving.dockerfile:1-2).  This module adds a
-real one: weights stored and carried in HBM as symmetric per-output-channel
-int8 (scale = max|w| / 127), dequantized inline inside the jitted forward.
+the GPU build (reference tf-serving.dockerfile:1-2).  This module adds two
+real ones, as two artifact schemes the engine dispatches on:
 
-What this buys, honestly stated:
+**``int8-weight-only``** (round 1): weights stored and carried in HBM as
+symmetric per-output-channel int8 (scale = max|w| / 127), dequantized
+inline inside the jitted forward.  Buys artifact bytes, weight HBM
+residency, and small-batch latency (the big pointwise convs are
+weight-bandwidth-bound at batch ~1-8).  Its stated limitation -- "bf16
+-activation matmuls do not hit the MXU's 2x int8 path (that needs int8
+activations too -- a calibration problem left for a later round)" -- is
+what the second scheme closes.
 
-- artifact bytes and weight HBM residency: 4x smaller than f32;
-- small-batch serving latency: at batch ~1-8 the big pointwise convs are
-  weight-bandwidth-bound, so int8 weight reads help exactly where the p50
-  target bites (the dequant multiply fuses into the conv's operand path);
-- logit drift: bounded and test-asserted (tests/test_quantize.py) --
-  per-channel symmetric int8 on conv/dense kernels only, BN and biases
-  stay f32.
+**``int8-w8a8``** (this round): offline *activation calibration* runs N
+representative uint8 images through the float graph and records, per
+quantized conv/dense layer, the absmax of that layer's input under a
+percentile clip; the resulting static per-tensor activation scale is
+stored in the artifact next to the ``_q8`` weight leaves.  The quantized
+forward (:func:`build_w8a8_forward`) then executes every calibrated
+conv/dense matmul as **int8 x int8 -> int32** (``preferred_element_type=
+jnp.int32``), which is the operand form the MXU's 2x int8 path consumes,
+and requantizes on the way out: ``y = acc_i32 * (s_act * s_w) + bias``.
+BatchNorm, biases, residual adds, pooling, and softmax/logits stay float32
+-- only the matmul operands are quantized, symmetric (zero-point 0, so
+'SAME' padding needs no zero-point correction).
 
-What it does NOT claim: bf16-activation matmuls do not hit the MXU's 2x
-int8 path (that needs int8 activations too -- a calibration problem left
-for a later round and recorded in ROADMAP.md).
+Serving safety: the engine gates ``int8-w8a8`` activation behind a
+golden-logits tolerance check at warmup ($KDLT_QUANT_TOL, top-1 agreement
++ max-abs bound); a mis-calibrated artifact refuses the int8-activation
+program and serves weight-only instead, loudly (runtime.engine).
 
-Wire format: each quantized kernel leaf becomes a dict
-``{"_q8": int8, "_q8_scale": f32}`` in the same tree position, so the
-msgpack artifact round-trips unchanged; ``metadata["quantization"]``
-carries the scheme tag the engine dispatches on.
+Wire format: each quantized kernel leaf is a dict in the same tree
+position -- ``{"_q8": int8, "_q8_scale": f32[out]}``, plus
+``"_q8_act_scale": f32[]`` once calibrated -- so the msgpack artifact
+round-trips unchanged; ``metadata["quantization"]`` carries the scheme
+tag the engine (and the registry's hot reload) dispatch on.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import numpy as np
 
 QUANT_KEY = "_q8"
 SCALE_KEY = "_q8_scale"
+ACT_SCALE_KEY = "_q8_act_scale"
 SCHEME = "int8-weight-only"
+SCHEME_W8A8 = "int8-w8a8"
+SCHEMES = (SCHEME, SCHEME_W8A8)
+
+# The warmup tolerance gate (runtime.engine._run_quant_gate): max-abs logit
+# drift of the w8a8 program vs the weight-only float reference, relative to
+# the reference's max-abs logit, must stay within $KDLT_QUANT_TOL, AND
+# top-1 agreement must reach GATE_TOP1.  Failing either refuses w8a8.
+QUANT_TOL_ENV = "KDLT_QUANT_TOL"
+DEFAULT_QUANT_TOL = 0.1
+GATE_TOP1 = 0.99
+
+# Operator scheme override: "auto" serves what the artifact says (gated);
+# "weight-only" refuses int8 activations fleet-wide without re-exporting
+# (the fast rollback knob when a calibrated model misbehaves in prod).
+QUANT_SCHEME_ENV = "KDLT_QUANT_SCHEME"
+
+# Calibration defaults: the percentile clip trades worst-case outlier
+# coverage for resolution everywhere else (absmax calibration lets ONE
+# outlier activation stretch the scale until typical values collapse into
+# a few int8 codes -- tests/test_quantize.py shows the effect on a
+# synthetic outlier stream).  99.9 is the classic post-training default.
+DEFAULT_CALIB_PERCENTILE = 99.9
+DEFAULT_CALIB_IMAGES = 32
+# Scale floor: a layer whose calibration stream is identically zero (dead
+# ReLU channel stack, all-black calibration set) must still get a finite,
+# positive scale -- quantizing by 0 would be a NaN factory.
+SCALE_FLOOR = 1e-6
+
 # Leaves eligible for quantization: conv/dense kernels. Everything else
 # (BN scale/bias/mean/var, biases) is tiny and precision-critical.
 _KERNEL_NAMES = ("kernel",)
+
+
+def resolve_quant_tol(explicit: float | None = None) -> float:
+    """Explicit arg > $KDLT_QUANT_TOL > 0.1 (relative max-abs logit drift)."""
+    if explicit is not None:
+        return float(explicit)
+    raw = os.environ.get(QUANT_TOL_ENV, "")
+    try:
+        return float(raw) if raw.strip() else DEFAULT_QUANT_TOL
+    except ValueError:
+        return DEFAULT_QUANT_TOL
+
+
+def resolve_scheme_override(explicit: str | None = None) -> str:
+    """$KDLT_QUANT_SCHEME: "auto" (default) or "weight-only" (refuse w8a8)."""
+    raw = (explicit if explicit is not None
+           else os.environ.get(QUANT_SCHEME_ENV, "")).strip().lower()
+    return "weight-only" if raw in ("weight-only", "weight_only", "w8") else "auto"
 
 
 def _is_quantized_leaf(v: Any) -> bool:
@@ -139,22 +200,338 @@ def is_quantized(variables: Any) -> bool:
     return found
 
 
-def write_quantized_version(root: str, name: str) -> str:
-    """Quantize <root>/<name>'s latest version into the NEXT version dir.
+# --- activation calibration (the w8a8 half) ---------------------------------
 
-    The model server's version watcher then hot-loads it exactly like any
-    other new version (TF-Serving's own convention for rolling a model).
-    No StableHLO is emitted: quantized artifacts serve through the live-jit
-    path (the exported-module format stays float-only and portable).
+
+def clip_scale(abs_values, percentile: float = DEFAULT_CALIB_PERCENTILE) -> np.float32:
+    """One layer's static activation scale from observed |activation| samples.
+
+    ``percentile`` 100 is plain absmax; below 100 clips the tail so a rare
+    outlier cannot stretch the scale until typical values collapse into a
+    few int8 codes.  Floored (SCALE_FLOOR) so a zero-range stream -- a dead
+    layer, an all-black calibration set -- still yields a finite positive
+    scale instead of a divide-by-zero.
+    """
+    a = np.asarray(abs_values, np.float32).ravel()
+    amax = float(np.percentile(a, percentile)) if a.size else 0.0
+    return np.float32(max(amax, SCALE_FLOOR) / 127.0)
+
+
+def _leaf_for(variables: Any, module) -> dict | None:
+    """The quantized kernel leaf a flax module owns, or None."""
+    node = variables.get("params") if isinstance(variables, dict) else None
+    for name in module.path:
+        node = node.get(name) if isinstance(node, dict) else None
+        if node is None:
+            return None
+    if not isinstance(node, dict):
+        return None
+    kernel = node.get("kernel")
+    return kernel if _is_quantized_leaf(kernel) else None
+
+
+def calibrate_activation_scales(
+    spec,
+    variables: Any,
+    qvars: Any,
+    images: np.ndarray,
+    percentile: float = DEFAULT_CALIB_PERCENTILE,
+    batch_size: int = 8,
+) -> dict[tuple, np.float32]:
+    """Run representative uint8 images through the FLOAT graph; return
+    {module path -> static per-tensor activation scale} for every layer
+    whose kernel ``qvars`` quantized.
+
+    Runs the un-jitted flax forward so activations are concrete: the
+    interceptor observes each quantized conv/dense layer's INPUT, takes the
+    |x| percentile per batch, and keeps the max across batches.  Offline-
+    only by design (artifact build time, never the serving path).
+    """
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import create_model
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    model = create_model(spec, dtype=None)
+    observed: dict[tuple, float] = {}
+
+    def interceptor(next_fun, args, kwargs, context):
+        m = context.module
+        if (
+            isinstance(m, (nn.Conv, nn.Dense))
+            and context.method_name == "__call__"
+            and _leaf_for(qvars, m) is not None
+        ):
+            x = np.abs(np.asarray(args[0], np.float32))
+            amax = float(np.percentile(x, percentile)) if x.size else 0.0
+            key = tuple(m.path)
+            observed[key] = max(observed.get(key, 0.0), amax)
+        return next_fun(*args, **kwargs)
+
+    images = np.asarray(images)
+    for i in range(0, max(1, images.shape[0]), batch_size):
+        chunk = images[i : i + batch_size]
+        if chunk.shape[0] == 0:
+            break
+        if chunk.dtype == np.uint8:
+            x = normalize(jnp.asarray(chunk), spec.preprocessing)
+        else:
+            x = jnp.asarray(chunk, jnp.float32)
+        with nn.intercept_methods(interceptor):
+            model.apply(variables, x, train=False)
+    return {
+        k: np.float32(max(v, SCALE_FLOOR) / 127.0) for k, v in observed.items()
+    }
+
+
+def attach_activation_scales(qvars: Any, scales: dict[tuple, Any]) -> Any:
+    """Store calibrated per-tensor activation scales next to their ``_q8``
+    weight leaves (``_q8_act_scale``, a 0-d float32 -- msgpack-safe)."""
+
+    def walk(tree, path):
+        if _is_quantized_leaf(tree):
+            s = scales.get(path[:-1])  # path ends with the kernel name
+            if s is not None:
+                return {**tree, ACT_SCALE_KEY: np.asarray(s, np.float32)}
+            return tree
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        return tree
+
+    return walk(qvars, ())
+
+
+def activation_scales(variables: Any) -> dict[tuple, np.float32]:
+    """{module path -> stored activation scale} of a calibrated tree."""
+    out: dict[tuple, np.float32] = {}
+
+    def walk(tree, path):
+        if _is_quantized_leaf(tree):
+            if ACT_SCALE_KEY in tree:
+                out[path[:-1]] = np.float32(np.asarray(tree[ACT_SCALE_KEY]))
+            return
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, path + (k,))
+
+    walk(variables.get("params", variables), ())
+    return out
+
+
+def is_calibrated(variables: Any) -> bool:
+    """True when at least one quantized leaf carries an activation scale."""
+    return bool(activation_scales(variables))
+
+
+# --- the w8a8 forward --------------------------------------------------------
+
+
+def _pair(v) -> tuple[int, int]:
+    if v is None:
+        return (1, 1)
+    if isinstance(v, int):
+        return (v, v)
+    t = tuple(int(x) for x in v)
+    return t if len(t) == 2 else (t[0], t[0])
+
+
+def _conv_padding(pad):
+    """flax Conv padding -> the lax conv form.  'CIRCULAR' is flax-side
+    pre-padding the rewrite does not replicate: refuse at trace time
+    (warmup fails loudly; the version watcher skips the artifact) rather
+    than silently compute a different convolution."""
+    if isinstance(pad, str):
+        if pad.upper() == "CIRCULAR":
+            raise NotImplementedError(
+                "int8-w8a8 does not support CIRCULAR conv padding"
+            )
+        return pad
+    if isinstance(pad, int):
+        return [(pad, pad), (pad, pad)]
+    out = []
+    for p in tuple(pad):
+        out.append((p, p) if isinstance(p, int) else tuple(int(x) for x in p))
+    return out
+
+
+def build_w8a8_forward(spec):
+    """``f(variables, images) -> float32 logits`` executing every calibrated
+    conv/dense as int8 x int8 -> int32.
+
+    ``variables`` is the calibrated quantized tree.  Inside the jit:
+
+    - the input's per-tensor activation scale and the kernel's per-channel
+      weight scales are static constants, so quantize-in (``round(x/s_a)``
+      clipped to [-127, 127]) and requantize-out (``acc * (s_a * s_w)``)
+      are elementwise ops XLA fuses into the surrounding graph;
+    - the matmul itself runs with int8 operands and
+      ``preferred_element_type=jnp.int32`` -- on TPU that is the MXU's 2x
+      int8 path; on CPU it is a (slow but exact) reference lowering, which
+      is what the tests pin numerics against;
+    - everything else -- normalization, BN, bias adds, residuals, pooling,
+      the classifier head, the float logits -- runs float32, exactly the
+      flax graph (the fused Pallas fast path is deliberately bypassed:
+      int8 operand layouts are a different kernel contract).
+
+    Quantized-but-uncalibrated leaves (defensive: a layer the calibration
+    stream never reached) dequantize inline and run float, i.e. degrade to
+    the weight-only semantics for that layer only.
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_deep_learning_tpu.models import create_model
+    from kubernetes_deep_learning_tpu.ops.preprocess import normalize
+
+    model = create_model(spec, dtype=None)
+
+    def _dequant(leaf):
+        return jnp.asarray(leaf[QUANT_KEY]).astype(jnp.float32) * jnp.asarray(
+            leaf[SCALE_KEY], jnp.float32
+        )
+
+    def forward(variables, images):
+        if images.dtype == jnp.uint8:
+            x = normalize(images, spec.preprocessing)
+        else:
+            x = images.astype(jnp.float32)
+
+        def interceptor(next_fun, args, kwargs, context):
+            m = context.module
+            if not (
+                isinstance(m, (nn.Conv, nn.Dense))
+                and context.method_name == "__call__"
+            ):
+                return next_fun(*args, **kwargs)
+            leaf = _leaf_for(variables, m)
+            if leaf is None:
+                return next_fun(*args, **kwargs)
+            xin = args[0].astype(jnp.float32)
+            sw = jnp.asarray(leaf[SCALE_KEY], jnp.float32)
+            if ACT_SCALE_KEY in leaf:
+                s_act = jnp.asarray(leaf[ACT_SCALE_KEY], jnp.float32)
+                lhs = jnp.clip(jnp.round(xin / s_act), -127, 127).astype(
+                    jnp.int8
+                )
+                rhs = jnp.asarray(leaf[QUANT_KEY])
+                out_scale = s_act * sw
+                acc_dtype = jnp.int32
+            else:  # uncalibrated: weight-only semantics for this layer
+                lhs, rhs, out_scale, acc_dtype = (
+                    xin, _dequant(leaf), None, jnp.float32
+                )
+            if isinstance(m, nn.Dense):
+                acc = jax.lax.dot_general(
+                    lhs, rhs, (((xin.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=acc_dtype,
+                )
+            else:
+                # Dilation is exact under symmetric int8: inserted zeros
+                # are the quantized zero (zero-point 0), same as padding.
+                acc = jax.lax.conv_general_dilated(
+                    lhs, rhs,
+                    window_strides=_pair(m.strides),
+                    padding=_conv_padding(m.padding),
+                    lhs_dilation=_pair(getattr(m, "input_dilation", None)),
+                    rhs_dilation=_pair(getattr(m, "kernel_dilation", None)),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=m.feature_group_count,
+                    preferred_element_type=acc_dtype,
+                )
+            y = acc.astype(jnp.float32)
+            if out_scale is not None:
+                y = y * out_scale
+            if m.use_bias:
+                node = variables["params"]
+                for name in m.path:
+                    node = node[name]
+                y = y + jnp.asarray(node["bias"], jnp.float32)
+            return y
+
+        with nn.intercept_methods(interceptor):
+            out = model.apply(variables, x, train=False)
+        return out.astype(jnp.float32)
+
+    return forward
+
+
+# --- artifact build ----------------------------------------------------------
+
+
+def representative_images(
+    spec, n: int, seed: int = 0, image_dir: str | None = None
+) -> np.ndarray:
+    """N uint8 calibration images at the spec's input shape.
+
+    ``image_dir``: real sample images (the production posture -- calibrate
+    on traffic-like data), loaded and resized with the spec's resize
+    filter, cycled if fewer than ``n``.  Without it, seeded uniform noise:
+    sufficient for the repro harness and for exercising the full pipeline,
+    but real deployments should calibrate on real images (GUIDE 9d).
+    """
+    h, w, c = spec.input_shape
+    if image_dir:
+        from PIL import Image
+
+        resample = (
+            Image.NEAREST if spec.resize_filter == "nearest" else Image.BILINEAR
+        )
+        files = sorted(
+            os.path.join(image_dir, f)
+            for f in os.listdir(image_dir)
+            if f.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".webp"))
+        )
+        if not files:
+            raise FileNotFoundError(f"no images under {image_dir!r}")
+        out = []
+        for i in range(n):
+            img = Image.open(files[i % len(files)]).convert("RGB")
+            out.append(
+                np.asarray(img.resize((w, h), resample), np.uint8)
+            )
+        return np.stack(out)
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, h, w, c), dtype=np.uint8)
+
+
+def write_quantized_version(
+    root: str,
+    name: str,
+    scheme: str = SCHEME,
+    calib_images: np.ndarray | None = None,
+    percentile: float = DEFAULT_CALIB_PERCENTILE,
+    min_size: int = 4096,
+    from_version: int | None = None,
+) -> str:
+    """Quantize <root>/<name>'s latest (or ``from_version``) float version
+    into the NEXT version dir, under ``scheme``.
+
+    ``int8-w8a8`` additionally calibrates activation scales from
+    ``calib_images`` (uint8 NHWC; see :func:`representative_images`) --
+    calibration happens HERE, at artifact build, never at serving time.
+    The model server's version watcher then hot-loads the result exactly
+    like any other new version (TF-Serving's own convention for rolling a
+    model).  No StableHLO is emitted: quantized artifacts serve through
+    the live-jit path (the exported-module format stays float-only and
+    portable).
     """
     from kubernetes_deep_learning_tpu.export import artifact as art
 
-    version = art.latest_version(root, name)
-    if version is None:
+    if scheme not in SCHEMES:
+        raise ValueError(f"unknown quantization scheme {scheme!r}; known: {SCHEMES}")
+    latest = art.latest_version(root, name)
+    if latest is None:
         raise FileNotFoundError(f"no versions of {name!r} under {root!r}")
+    version = latest if from_version is None else from_version
     src = art.load_artifact(art.version_dir(root, name, version))
     if src.metadata.get("quantization"):
-        raise ValueError(f"{name} v{version} is already quantized")
+        raise ValueError(
+            f"{name} v{version} is already quantized "
+            f"({src.metadata['quantization']}); quantize from a float version"
+            + ("" if from_version is not None else " via from_version")
+        )
     # Quantized artifacts drop the exported StableHLO (module=None below):
     # they can only serve through the live-jit in-tree forward.  A family
     # with no in-tree model would produce an unservable version that the
@@ -170,24 +547,84 @@ def write_quantized_version(root: str, name: str) -> str:
             "in-tree forward, and quantized artifacts (module=None) can "
             "only serve via live jit"
         ) from e
-    qvars = quantize_variables(src.variables)
+    qvars = quantize_variables(src.variables, min_size=min_size)
     meta = {
         **src.metadata,
-        "quantization": SCHEME,
+        "quantization": scheme,
         "quantized_from_version": version,
     }
-    dst = art.version_dir(root, name, version + 1)
+    if scheme == SCHEME_W8A8:
+        if calib_images is None:
+            calib_images = representative_images(src.spec, DEFAULT_CALIB_IMAGES)
+        scales = calibrate_activation_scales(
+            src.spec, src.variables, qvars, calib_images, percentile=percentile
+        )
+        qvars = {
+            **qvars,
+            "params": attach_activation_scales(qvars["params"], scales),
+        }
+        meta["calibration"] = {
+            "images": int(np.asarray(calib_images).shape[0]),
+            "percentile": float(percentile),
+            "layers": len(scales),
+        }
+    dst = art.version_dir(root, name, latest + 1)
     return art.save_artifact(dst, src.spec, qvars, None, meta)
 
 
 def main(argv: list[str] | None = None) -> int:
-    """CLI: kdlt-quantize --models <root> --model <name>."""
+    """CLI: kdlt-quantize --models <root> --model <name> [--scheme int8-w8a8]."""
     import argparse
 
-    p = argparse.ArgumentParser(description="int8 weight-only quantization")
+    p = argparse.ArgumentParser(description="int8 artifact quantization")
     p.add_argument("--models", required=True, help="artifact root")
     p.add_argument("--model", required=True, help="model name under the root")
+    p.add_argument(
+        "--scheme", default=SCHEME, choices=list(SCHEMES),
+        help="int8-weight-only (weights dequantize inline; no calibration) "
+        "or int8-w8a8 (calibrated activation scales; matmuls run int8xint8 "
+        "on the MXU's 2x path, gated at warmup by KDLT_QUANT_TOL)",
+    )
+    p.add_argument(
+        "--calibrate-images", type=int, default=DEFAULT_CALIB_IMAGES,
+        help="calibration batch size for --scheme int8-w8a8",
+    )
+    p.add_argument(
+        "--calibrate-percentile", type=float, default=DEFAULT_CALIB_PERCENTILE,
+        help="percentile clip on |activation| (100 = absmax)",
+    )
+    p.add_argument(
+        "--calibrate-dir", default=None,
+        help="directory of representative images (default: seeded noise; "
+        "calibrate on real traffic samples in production)",
+    )
+    p.add_argument("--calibrate-seed", type=int, default=0)
+    p.add_argument(
+        "--from-version", type=int, default=None,
+        help="quantize this (float) version instead of the latest",
+    )
     args = p.parse_args(argv)
-    path = write_quantized_version(args.models, args.model)
-    print(f"wrote quantized artifact: {path}")
+    calib = None
+    if args.scheme == SCHEME_W8A8:
+        from kubernetes_deep_learning_tpu.export import artifact as art
+
+        version = (
+            args.from_version
+            if args.from_version is not None
+            else art.latest_version(args.models, args.model)
+        )
+        if version is None:
+            raise SystemExit(f"no versions of {args.model!r} under {args.models!r}")
+        spec = art.load_artifact(
+            art.version_dir(args.models, args.model, version)
+        ).spec
+        calib = representative_images(
+            spec, args.calibrate_images, seed=args.calibrate_seed,
+            image_dir=args.calibrate_dir,
+        )
+    path = write_quantized_version(
+        args.models, args.model, scheme=args.scheme, calib_images=calib,
+        percentile=args.calibrate_percentile, from_version=args.from_version,
+    )
+    print(f"wrote quantized artifact ({args.scheme}): {path}")
     return 0

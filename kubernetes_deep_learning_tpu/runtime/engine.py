@@ -57,6 +57,37 @@ WATCHDOG_FLOOR_S_ENV = "KDLT_WATCHDOG_FLOOR_S"
 DEFAULT_WATCHDOG_MULTIPLE = 10.0
 DEFAULT_WATCHDOG_FLOOR_S = 30.0
 
+# Buffer donation on the jitted forward (KDLT_DONATE=0 disables): the batch
+# argument is donated (donate_argnums), so once the program consumes the
+# uint8 batch its HBM is returned to XLA for intermediates instead of
+# pinning a dead buffer for the call's duration.  The engine's own dispatch
+# path always passes a freshly-assembled (or padded) batch, so nothing
+# aliases a donated buffer after dispatch; on backends where the donation
+# cannot be used the program is bit-identical and jax merely drops it (the
+# advisory warning is silenced below -- it would fire once per bucket
+# compile on every CPU dev run).
+DONATE_ENV = "KDLT_DONATE"
+
+
+def donation_enabled(explicit: bool | None = None) -> bool:
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(DONATE_ENV, "").strip() != "0"
+
+
+def _donate_jit(fn, donate: bool):
+    """jax.jit with the batch argument donated (argnum 1) when enabled."""
+    import jax
+
+    if not donate:
+        return jax.jit(fn)
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
+    return jax.jit(fn, donate_argnums=(1,))
+
 
 def _env_float(name: str, default: float) -> float:
     raw = os.environ.get(name, "")
@@ -564,24 +595,49 @@ class InferenceEngine:
         # flax graph -- not the approximate fused kernel -- is what gets
         # checked (xception_fast.py's stated invariant).
         self._fast = fast
-        # int8 weight-only artifacts (ops.quantize): weights stay int8 in
-        # HBM and dequantize inline inside the jit (fused into the convs'
-        # operand path -- the small-batch weight-bandwidth win).  Mesh
-        # serving dequantizes at load instead: the partition rules address
-        # float kernel leaves, not the {_q8, _q8_scale} wire form.
+        # int8 artifacts (ops.quantize), dispatched on the scheme tag:
+        # "int8-weight-only" keeps weights int8 in HBM and dequantizes
+        # inline inside the jit (fused into the convs' operand path -- the
+        # small-batch weight-bandwidth win); "int8-w8a8" additionally
+        # quantizes activations with the artifact's calibrated static
+        # scales so conv/dense matmuls run int8 x int8 -> int32 on the
+        # MXU's 2x path -- gated at warmup by the golden-logits tolerance
+        # check (_run_quant_gate): past $KDLT_QUANT_TOL the engine refuses
+        # the int8-activation program and serves weight-only, loudly.
+        # Mesh serving dequantizes at load instead: the partition rules
+        # address float kernel leaves, not the {_q8, _q8_scale} wire form.
+        self._donate = donation_enabled()
         self._quantization = artifact.metadata.get("quantization") or None
+        self._quantization_active = self._quantization
+        self.quant_gate_failed = False
         if self._quantization is not None:
             from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
 
-            if self._quantization != quant_lib.SCHEME:
+            if self._quantization not in quant_lib.SCHEMES:
                 raise ValueError(
                     f"unknown quantization scheme {self._quantization!r}"
                 )
+            if (
+                self._quantization == quant_lib.SCHEME_W8A8
+                and quant_lib.resolve_scheme_override() == "weight-only"
+            ):
+                # Operator rollback knob: serve the calibrated artifact as
+                # weight-only fleet-wide without re-exporting.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "%s=weight-only: serving %s without int8 activations",
+                    quant_lib.QUANT_SCHEME_ENV, self.spec.name,
+                )
+                self._quantization_active = quant_lib.SCHEME
             if mesh is not None:
                 import dataclasses
 
                 # Host-side numpy dequant: the jnp variant would briefly
                 # materialize the full f32 tree on one device at load.
+                # (w8a8 included: the sharded forward is float -- int8
+                # activations stay a single-device program for now.)
+                self._quantization_active = None
                 artifact = dataclasses.replace(
                     artifact,
                     variables=quant_lib.dequantize_variables_host(
@@ -662,7 +718,9 @@ class InferenceEngine:
             and self._quantization is None  # modules are traced float-only
             and artifact.module_bytes_for(platform) is not None
         ):
-            self._jitted = jax.jit(artifact.exported_for(platform).call)
+            self._jitted = _donate_jit(
+                artifact.exported_for(platform).call, self._donate
+            )
             # The exported module is traced for the uint8 wire path only;
             # float32 "pre-normalized" input (protocol.decode_predict_request's
             # JSON debug path) runs through the in-tree forward instead,
@@ -685,6 +743,15 @@ class InferenceEngine:
                 self.spec, jnp.dtype(self._compute_dtype), self._fast, backend=platform
             )
             self._fast_engaged = self._fast
+            from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+            if self._quantization_active == quant_lib.SCHEME_W8A8:
+                # The w8a8 program is the exact graph with int8 operands;
+                # the fused fast path only re-enters if the tolerance gate
+                # downgrades to weight-only (_downgrade_w8a8 restores it).
+                self._fast_after_downgrade = self._fast
+                self._fast = False
+                self._fast_engaged = False
             self._build_live_jit()
         # The f32 debug path dispatches under its own lock: its lazy first
         # compile (tens of seconds on TPU) must never stall warm uint8
@@ -722,10 +789,32 @@ class InferenceEngine:
             flops_lib.peak_tflops(self._device, str(self._compute_dtype)),
             self._flops_per_image,
         )
+        # Quantization scheme + tolerance-gate accounting (kdlt_quant_*,
+        # minted centrally): the scheme gauge is 1 for the ACTIVE scheme
+        # (post-gate, post-override), so a downgraded pod is alertable.
+        self._m_quant = metrics_lib.quant_metrics(registry)
+        self._refresh_scheme_gauge()
+
+    def _refresh_scheme_gauge(self) -> None:
+        active = self._quantization_active or "float32"
+        for scheme, gauge in self._m_quant["scheme"].items():
+            gauge.set(1.0 if scheme == active else 0.0)
 
     @property
     def ready(self) -> bool:
         return self._ready.is_set()
+
+    @property
+    def quantization(self) -> str | None:
+        """The artifact's requested quantization scheme tag (or None)."""
+        return self._quantization
+
+    @property
+    def quantization_active(self) -> str | None:
+        """The scheme actually serving: the requested one unless the
+        warmup tolerance gate or $KDLT_QUANT_SCHEME downgraded int8-w8a8
+        to weight-only (or mesh serving dequantized to float)."""
+        return self._quantization_active
 
     def warmup(self, workers: int = 4) -> float:
         """Compile every bucket shape; gate readiness on completion.
@@ -748,17 +837,119 @@ class InferenceEngine:
         t0 = time.perf_counter()
         while True:
             failure = self._warm_buckets(max(1, workers))
-            if failure is None:
-                break
-            bucket, exc = failure
-            if not self._degrade_fast(bucket, exc):
-                raise exc
-            # Degraded: loop re-warms every bucket on the exact graph,
-            # with its own per-bucket retry budget.
+            if failure is not None:
+                bucket, exc = failure
+                if not self._degrade_fast(bucket, exc):
+                    raise exc
+                # Degraded: loop re-warms every bucket on the exact graph,
+                # with its own per-bucket retry budget.
+                continue
+            if self._quant_gate_pending() and not self._run_quant_gate():
+                # The calibrated int8-activation program drifted past
+                # KDLT_QUANT_TOL: refuse w8a8, fall back to weight-only,
+                # loop to re-warm the replacement programs.  Readiness is
+                # still gated on the REPLACEMENT being warm -- a gate
+                # failure costs boot time, never cold-compile stalls on
+                # live traffic.
+                self._downgrade_w8a8()
+                continue
+            break
         dt = time.perf_counter() - t0
         self._m_warmup.set(dt)
         self._ready.set()
         return dt
+
+    # --- w8a8 tolerance gate ----------------------------------------------
+
+    def _quant_gate_pending(self) -> bool:
+        from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+        return (
+            self._quantization_active == quant_lib.SCHEME_W8A8
+            and self.mesh is None
+            and not getattr(self, "_quant_gate_checked", False)
+        )
+
+    def _run_quant_gate(self) -> bool:
+        """Golden-logits tolerance check: the w8a8 program's logits on a
+        deterministic uint8 batch vs the weight-only float reference (the
+        exact program the fallback would serve).  Passes iff top-1
+        agreement >= GATE_TOP1 AND relative max-abs drift <= KDLT_QUANT_TOL.
+
+        Runs AFTER the buckets warmed, so the w8a8 side reuses a compiled
+        bucket program; the reference costs one extra (smallest-gate-
+        bucket) compile at boot -- the price of never activating a
+        mis-calibrated artifact.
+        """
+        import logging
+
+        import jax
+        import jax.numpy as jnp
+
+        from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+        self._quant_gate_checked = True
+        tol = quant_lib.resolve_quant_tol()
+        b = self.bucket_for(min(8, self.max_batch))
+        rng = np.random.default_rng(0)
+        x = rng.integers(
+            0, 256, size=(b, *self.spec.input_shape), dtype=np.uint8
+        )
+        got = np.asarray(self._jitted(self._variables, x))[:b]
+        prev = self._quantization_active
+        try:
+            # The reference IS the fallback program: _live_forward with the
+            # weight-only scheme active (inline dequant, same compute dtype).
+            self._quantization_active = quant_lib.SCHEME
+            ref_fn = jax.jit(
+                self._live_forward(jnp.dtype(self._compute_dtype))
+            )
+        finally:
+            self._quantization_active = prev
+        ref = np.asarray(ref_fn(self._variables, x))[:b]
+        drift = float(
+            np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        )
+        top1 = float((got.argmax(-1) == ref.argmax(-1)).mean())
+        ok = drift <= tol and top1 >= quant_lib.GATE_TOP1
+        log = logging.getLogger(__name__)
+        if ok:
+            log.info(
+                "w8a8 tolerance gate PASSED for %s: top-1 agreement %.4f "
+                "(>= %.2f), relative max-abs logit drift %.4f (<= %s=%.3g) "
+                "over a %d-image golden batch; serving int8 activations",
+                self.spec.name, top1, quant_lib.GATE_TOP1, drift,
+                quant_lib.QUANT_TOL_ENV, tol, b,
+            )
+        else:
+            log.error(
+                "w8a8 tolerance gate FAILED for %s: top-1 agreement %.4f "
+                "(need >= %.2f), relative max-abs logit drift %.4f (need "
+                "<= %s=%.3g) over a %d-image golden batch; REFUSING int8 "
+                "activations and serving weight-only -- re-calibrate the "
+                "artifact (kdlt-export --calibrate / kdlt-quantize "
+                "--scheme int8-w8a8)",
+                self.spec.name, top1, quant_lib.GATE_TOP1, drift,
+                quant_lib.QUANT_TOL_ENV, tol, b,
+            )
+        self.quant_gate_drift = drift
+        self.quant_gate_top1 = top1
+        return ok
+
+    def _downgrade_w8a8(self) -> None:
+        """Swap the forward to weight-only after a gate failure (the
+        warmup loop re-warms the replacement buckets)."""
+        from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+        self.quant_gate_failed = True
+        self._quantization_active = quant_lib.SCHEME
+        self._m_quant["gate_failures"].inc()
+        self._refresh_scheme_gauge()
+        # Weight-only serving regains the fused fast path the w8a8 program
+        # had to bypass (its operand layouts are a float kernel contract).
+        self._fast = getattr(self, "_fast_after_downgrade", self._fast)
+        self._fast_engaged = self._fast
+        self._build_live_jit()
 
     def _warm_buckets(self, workers: int) -> tuple[int, Exception] | None:
         """Compile+run every bucket, ``workers`` at a time; returns the
@@ -843,29 +1034,62 @@ class InferenceEngine:
         return True
 
     def _build_live_jit(self) -> None:
-        """(Re)build the live-jit forward pair; __init__ and _degrade_fast
-        must construct it identically or a degraded engine would run a
-        differently-configured program."""
-        import jax
+        """(Re)build the live-jit forward pair; __init__, _degrade_fast and
+        _downgrade_w8a8 must construct it identically or a degraded engine
+        would run a differently-configured program.  The batch argument is
+        donated (KDLT_DONATE=0 disables): the dispatch path always hands
+        the program a freshly-assembled batch, so its device buffer can be
+        recycled into the program's own working set."""
         import jax.numpy as jnp
 
-        self._jitted = jax.jit(self._live_forward(jnp.dtype(self._compute_dtype)))
+        self._jitted = _donate_jit(
+            self._live_forward(jnp.dtype(self._compute_dtype)), self._donate
+        )
         self._jitted_f32 = self._jitted
 
     def _live_forward(self, dtype):
-        """The live-jit forward, with inline dequantization when the
-        artifact carries int8 weights."""
+        """The live-jit forward for the ACTIVE quantization scheme: plain
+        float graph, inline weight dequantization (int8-weight-only), or
+        the calibrated int8 x int8 -> int32 program (int8-w8a8)."""
         from kubernetes_deep_learning_tpu.models import build_forward
+        from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
 
+        if self._quantization_active == quant_lib.SCHEME_W8A8:
+            # Exact flax graph with every calibrated conv/dense swapped for
+            # the int8-operand form; the fused Pallas fast path is bypassed
+            # (its kernels are a float operand-layout contract).
+            return quant_lib.build_w8a8_forward(self.spec)
         base = build_forward(self.spec, dtype=dtype, fast=self._fast)
         if self._quantization is None:
             return base
-        from kubernetes_deep_learning_tpu.ops.quantize import dequantize_variables
 
         def forward(variables, images):
-            return base(dequantize_variables(variables), images)
+            return base(quant_lib.dequantize_variables(variables), images)
 
         return forward
+
+    def donation_info(self, bucket: int) -> dict[str, bool]:
+        """Whether the compiled forward donates its arguments at one bucket
+        shape, from jax.stages.Lowered.args_info (trace+lower only -- no
+        XLA compile, no device work).  The regression surface for the
+        donation audit: ``images`` must be True on every bucket (unless
+        KDLT_DONATE=0), ``variables`` must ALWAYS be False -- donating the
+        weights would free them under the next request.
+        """
+        import jax
+
+        x = np.zeros((bucket, *self.spec.input_shape), np.uint8)
+        (var_info, img_info), _kwargs = self._jitted.lower(
+            self._variables, x
+        ).args_info
+        return {
+            "variables": any(
+                bool(i.donated) for i in jax.tree_util.tree_leaves(var_info)
+            ),
+            "images": all(
+                bool(i.donated) for i in jax.tree_util.tree_leaves(img_info)
+            ),
+        }
 
     def _flops_per_image(self, bucket: int) -> float | None:
         """FLOPs/image at one bucket shape, for the live MFU gauges.

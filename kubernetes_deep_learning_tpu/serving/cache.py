@@ -54,12 +54,20 @@ from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 CACHE_ENV = "KDLT_CACHE"
 TTL_ENV = "KDLT_CACHE_TTL_S"
 MAX_MB_ENV = "KDLT_CACHE_MAX_MB"
+NEG_TTL_ENV = "KDLT_CACHE_NEG_TTL_S"
 
 # Staleness ceiling between an artifact reload and the first miss that
 # teaches the gateway the new hash; 60 s matches the version watcher's
 # default poll cadence (one watcher period of bounded staleness).
 DEFAULT_TTL_S = 60.0
 DEFAULT_MAX_MB = 64.0
+# Negative caching: a hammered bad URL (404/400) answers from the cache
+# for this long instead of paying the full fetch path per request.  Short
+# by design -- a 404 can become a 200 the moment the object is uploaded --
+# and 0 disables it.  5xx are NEVER negative-cached: they are the
+# upstream's transient state, not the request's.
+DEFAULT_NEG_TTL_S = 5.0
+NEGATIVE_STATUSES = (400, 404)
 
 # A client salt is hashed, never echoed, but still bound it: a multi-KB
 # header must not become free amplification of the hash input.
@@ -196,9 +204,10 @@ class SingleFlight:
 
 class _Entry:
     __slots__ = ("body", "ctype", "nbytes", "model", "artifact_hash",
-                 "expires_s", "stored_s", "hits")
+                 "expires_s", "stored_s", "hits", "status")
 
-    def __init__(self, body, ctype, model, artifact_hash, expires_s):
+    def __init__(self, body, ctype, model, artifact_hash, expires_s,
+                 status=200):
         self.body = body
         self.ctype = ctype
         self.nbytes = len(body)
@@ -207,6 +216,7 @@ class _Entry:
         self.expires_s = expires_s
         self.stored_s = time.monotonic()
         self.hits = 0
+        self.status = status
 
 
 class ResponseCache:
@@ -225,9 +235,15 @@ class ResponseCache:
         registry: metrics_lib.Registry | None = None,
         ttl_s: float | None = None,
         max_mb: float | None = None,
+        neg_ttl_s: float | None = None,
     ):
         self.ttl_s = ttl_s if ttl_s is not None else _env_float(
             TTL_ENV, DEFAULT_TTL_S
+        )
+        # Negative-entry TTL (404/400): $KDLT_CACHE_NEG_TTL_S, 0 disables
+        # negative caching entirely (only 200s are stored).
+        self.neg_ttl_s = neg_ttl_s if neg_ttl_s is not None else _env_float(
+            NEG_TTL_ENV, DEFAULT_NEG_TTL_S
         )
         max_mb = max_mb if max_mb is not None else _env_float(
             MAX_MB_ENV, DEFAULT_MAX_MB
@@ -242,6 +258,7 @@ class ResponseCache:
         self.hits = 0
         self.misses = 0
         self.coalesced = 0
+        self.negative_hits = 0
         self.evictions: dict[str, int] = {
             reason: 0 for reason, _ in metrics_lib.CACHE_EVICTION_REASONS
         }
@@ -302,14 +319,25 @@ class ResponseCache:
             self._count("misses")
             self._refresh_gauges_locked()
 
-    def get(self, key: str) -> tuple[bytes, str] | None:
-        """Hit -> (body, ctype) and LRU-touch; miss/expired -> None (the
-        caller decides whether the miss leads a flight or coalesces, and
-        counts it via count_miss / count_coalesced)."""
+    def storable_status(self, status: int) -> bool:
+        """Whether a response with this status may enter the cache: 200
+        always; 400/404 only while negative caching is on (neg_ttl_s > 0).
+        5xx (and everything else) never -- an upstream's transient failure
+        must not be replayed to innocent followers."""
+        if status == 200:
+            return True
+        return status in NEGATIVE_STATUSES and self.neg_ttl_s > 0
+
+    def lookup(self, key: str) -> tuple[int, bytes, str] | None:
+        """Hit -> (status, body, ctype) and LRU-touch; miss/expired ->
+        None (the caller decides whether the miss leads a flight or
+        coalesces, and counts it via count_miss / count_coalesced).
+        Negative entries (status != 200) count as hits AND as
+        negative_hits."""
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
-            if entry is not None and 0 < self.ttl_s and entry.expires_s <= now:
+            if entry is not None and entry.expires_s <= now:
                 self._evict_locked(key, "ttl")
                 entry = None
             if entry is None:
@@ -319,23 +347,34 @@ class ResponseCache:
             entry.hits += 1
             self.hits += 1
             self._count("hits")
+            if entry.status != 200:
+                self.negative_hits += 1
+                self._count("neg_hits")
             self._refresh_gauges_locked()
-            return entry.body, entry.ctype
+            return entry.status, entry.body, entry.ctype
+
+    def get(self, key: str) -> tuple[bytes, str] | None:
+        """lookup() without the status (the original surface)."""
+        got = self.lookup(key)
+        return None if got is None else (got[1], got[2])
 
     def put(
         self, key: str, body: bytes, ctype: str, model: str,
-        artifact_hash: str,
+        artifact_hash: str, status: int = 200,
     ) -> bool:
-        """Store one successful response; returns False when the body
-        alone exceeds the whole byte budget (never cached)."""
-        if len(body) > self.max_bytes:
+        """Store one cacheable response; returns False when the body alone
+        exceeds the whole byte budget, or the status is not storable.
+        Negative entries (400/404) live under the short neg_ttl_s."""
+        if len(body) > self.max_bytes or not self.storable_status(status):
             return False
-        expires = time.monotonic() + self.ttl_s
+        ttl = self.ttl_s if status == 200 else self.neg_ttl_s
+        expires = time.monotonic() + ttl if ttl > 0 else float("inf")
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
-            entry = _Entry(body, ctype, model, artifact_hash, expires)
+            entry = _Entry(body, ctype, model, artifact_hash, expires,
+                           status=status)
             self._entries[key] = entry
             self._bytes += entry.nbytes
             if self._m is not None:
@@ -390,16 +429,21 @@ class ResponseCache:
         with self._lock:
             total = self.hits + self.misses
             per_model: dict[str, int] = {}
+            negative = 0
             for e in self._entries.values():
                 per_model[e.model] = per_model.get(e.model, 0) + 1
+                negative += e.status != 200
             return {
                 "entries": len(self._entries),
+                "negative_entries": negative,
                 "resident_bytes": self._bytes,
                 "max_bytes": self.max_bytes,
                 "ttl_s": self.ttl_s,
+                "neg_ttl_s": self.neg_ttl_s,
                 "hits": self.hits,
                 "misses": self.misses,
                 "coalesced": self.coalesced,
+                "negative_hits": self.negative_hits,
                 "hit_ratio": round(self.hits / total, 4) if total else 0.0,
                 "evictions": dict(self.evictions),
                 "entries_by_model": per_model,

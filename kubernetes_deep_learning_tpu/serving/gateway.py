@@ -127,6 +127,7 @@ class Gateway:
         cache: bool | None = None,
         cache_ttl_s: float | None = None,
         cache_max_mb: float | None = None,
+        cache_neg_ttl_s: float | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -193,7 +194,8 @@ class Gateway:
         # whole subsystem (cache AND coalescing) -- the exact legacy path.
         self.cache = (
             cache_lib.ResponseCache(
-                self.registry, ttl_s=cache_ttl_s, max_mb=cache_max_mb
+                self.registry, ttl_s=cache_ttl_s, max_mb=cache_max_mb,
+                neg_ttl_s=cache_neg_ttl_s,
             )
             if cache_lib.cache_enabled(cache)
             else None
@@ -1060,14 +1062,22 @@ class Gateway:
         """
         key = self._cache_key(routed, str(req.get("url", "")), salt)
         w0 = trace_lib.now_s()
-        cached = self.cache.get(key)
+        cached = self.cache.lookup(key)
         if cached is not None:
-            out, ctype = cached
+            # Positive (200) or negative (recent 404/400 under the short
+            # KDLT_CACHE_NEG_TTL_S) -- either way the full fetch path is
+            # skipped; a negative hit still answers with ITS error status
+            # and counts as this client's error.
+            hit_status, out, ctype = cached
+            if hit_status != 200:
+                self._m_errors.inc()
             self.tracer.record(
                 rid, "gateway.cache", w0, trace_lib.now_s() - w0,
-                parent_id=rt.span_id, result="hit",
+                parent_id=rt.span_id, result="hit", status=hit_status,
             )
-            return 200, out, ctype, {cache_lib.CACHE_STATUS_HEADER: "hit"}
+            return hit_status, out, ctype, {
+                cache_lib.CACHE_STATUS_HEADER: "hit"
+            }
         flight, leader = self._singleflight.begin(key)
         if not leader:
             self.cache.count_coalesced()
@@ -1134,7 +1144,7 @@ class Gateway:
             self._singleflight.finish(key, flight)
             flight.fail(e)
             raise
-        if status == 200 and not salt:
+        if not salt and self.cache.storable_status(status):
             # Store BEFORE detaching the flight: an arrival in between
             # hits the cache instead of starting a duplicate flight.
             # Salted requests are deliberate cache opt-outs: they
@@ -1143,9 +1153,13 @@ class Gateway:
             # learned the model's artifact hash / contract (the first
             # request of a model, or the first after a reload), and the
             # entry must live under the key every future lookup computes.
+            # storable_status: 200 always; 404/400 only under the short
+            # negative TTL (a hammered bad URL stops paying the fetch
+            # path); 5xx never -- upstream failures are not replayable.
             self.cache.put(
                 self._cache_key(routed, str(req.get("url", "")), salt),
                 out, ctype, routed, self.cache.resolved_hash(routed),
+                status=status,
             )
         self._singleflight.finish(key, flight)
         flight.resolve((status, out, ctype, extra))

@@ -166,4 +166,17 @@ class ModelRegistry:
             "buckets": list(getattr(engine, "buckets", ())),
             "family": getattr(served.artifact.spec, "family", None),
             "labels": list(served.artifact.spec.labels),
+            # Quantization scheme, requested vs ACTIVE: these differ when
+            # the warmup tolerance gate (or $KDLT_QUANT_SCHEME) downgraded
+            # an int8-w8a8 artifact to weight-only serving -- the status
+            # page is how an operator confirms which program a replica
+            # actually runs after a hot reload.
+            "quantization": (
+                getattr(engine, "quantization", None)
+                or getattr(served.artifact, "metadata", {}).get("quantization")
+            ),
+            "quantization_active": getattr(
+                engine, "quantization_active",
+                getattr(served.artifact, "metadata", {}).get("quantization"),
+            ),
         }

@@ -46,10 +46,31 @@ class Checkpointer:
         )
 
     def save(self, state: Any, force: bool = False) -> bool:
-        """Snapshot ``state`` at its own step counter."""
+        """Snapshot ``state`` at its own step counter.
+
+        The state is copied to HOST memory synchronously before the async
+        write starts: the training loop donates ``state`` into the next
+        train_step (trainer.build_train_step, ``donate_argnums=(0,)``), so
+        orbax's background serializer would otherwise still be reading
+        device buffers XLA has already recycled -- an intermittent
+        use-after-free segfault (reproduced under the tier-1 suite; the
+        race window moves with compile timing).  The copy is the only
+        synchronous part; serialization/disk IO stay async.  Multi-host
+        (non-fully-addressable) shards pass through untouched -- each
+        host's serializer reads only addressable shards, and those fleets
+        gate donation differently (the sharded train step returns a NEW
+        state before followers save).
+        """
+        import numpy as np
+
+        def snapshot(x):
+            if isinstance(x, jax.Array) and x.is_fully_addressable:
+                return np.asarray(x)
+            return x
+
         return self._mngr.save(
             int(jax.device_get(state.step)),
-            args=self._ocp.args.StandardSave(state),
+            args=self._ocp.args.StandardSave(jax.tree.map(snapshot, state)),
             force=force,
         )
 

@@ -517,6 +517,11 @@ def cache_metrics(registry: "Registry") -> dict:
             "requests coalesced onto another identical request's in-flight "
             "upstream call (singleflight followers)",
         ),
+        "neg_hits": registry.counter(
+            "kdlt_cache_negative_hits_total",
+            "requests answered from a negative-cache entry (a recent 404/"
+            "400 for the same content key, held for KDLT_CACHE_NEG_TTL_S)",
+        ),
         "bytes": registry.counter(
             "kdlt_cache_bytes_total",
             "response bytes inserted into the cache",
@@ -538,6 +543,40 @@ def cache_metrics(registry: "Registry") -> dict:
             )
             for reason, help in CACHE_EVICTION_REASONS
         },
+    }
+
+
+# Quantization serving state (ops.quantize + runtime.engine).  The scheme
+# label's value set is exactly this tuple (bounded by construction); minted
+# HERE and nowhere else -- tools/check_metrics.py confines the kdlt_quant_
+# prefix and the ``scheme`` label to this module.
+QUANT_SCHEMES = (
+    ("float32", "unquantized float serving"),
+    ("int8-weight-only", "int8 weights dequantized inline; float activations"),
+    ("int8-w8a8", "int8 weights AND calibrated int8 activations (MXU 2x path)"),
+)
+
+
+def quant_metrics(registry: "Registry") -> dict:
+    """One engine's quantization accounting: which scheme is ACTIVE (the
+    gauge is 1 for exactly one scheme -- post-tolerance-gate, post-
+    $KDLT_QUANT_SCHEME override, so a silently-downgraded pod is
+    alertable) and how many times the warmup tolerance gate refused
+    int8 activations (kdlt_quant_gate_failures_total)."""
+    return {
+        "scheme": {
+            scheme: registry.with_labels(scheme=scheme).gauge(
+                "kdlt_quant_scheme",
+                f"1 while this scheme is the one actually serving: {help}",
+            )
+            for scheme, help in QUANT_SCHEMES
+        },
+        "gate_failures": registry.counter(
+            "kdlt_quant_gate_failures_total",
+            "warmup golden-logits tolerance gate failures: a calibrated "
+            "int8-w8a8 artifact drifted past KDLT_QUANT_TOL (or top-1 "
+            "agreement) and was downgraded to weight-only serving",
+        ),
     }
 
 

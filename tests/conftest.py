@@ -23,6 +23,16 @@ from kubernetes_deep_learning_tpu.utils.platform import force_platform  # noqa: 
 
 force_platform("cpu")
 
+# NOTE on the persistent XLA compile cache: pointing the suite at
+# utils/compilecache's mechanism (JAX_COMPILATION_CACHE_DIR) cuts ~90 s of
+# warm-rerun wall clock, but on this jaxlib (0.4.37, CPU) suite runs with a
+# WARM cache intermittently die of heap corruption around the orbax async-
+# checkpoint tests -- cache-deserialized executables with real input/output
+# aliasing (the donated train state) are implicated; the crash survives
+# scoping the cache away from the checkpoint module itself, so the
+# corruption source is nonlocal.  Do not re-enable wholesale; opt in per
+# developer run via KDLT_COMPILE_CACHE_DIR at your own risk.
+
 import pytest  # noqa: E402
 
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec  # noqa: E402

@@ -394,3 +394,52 @@ def test_pipeline_ab_depth2_closes_the_host_gap():
     assert d1["gap_vs_device_bound"] >= 0.15, d1
     assert d2["gap_vs_device_bound"] <= 0.05, d2
     assert out["value"] > 1.1  # wall-clock speedup from pipelining alone
+
+
+# --- full-int8 quantization A/B (ISSUE 9) ---------------------------------
+
+
+def test_dry_run_quant_ab_echoes_the_quant_config():
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--quant-ab", "3", "--quant-size", "48",
+         "--quant-buckets", "1,4", "--quant-calib-images", "16",
+         "--quant-min-size", "500000", "--dry-run"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=60,
+    )
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["mode"] == "quant_ab"
+    q = out["quant"]
+    assert q["reps"] == 3
+    assert q["size"] == 48
+    assert q["buckets"] == [1, 4]
+    assert q["calib_images"] == 16
+    assert q["min_size"] == 500000
+
+
+@pytest.mark.slow
+def test_quant_ab_w8a8_beats_f32_on_proxy_within_tolerance():
+    """ISSUE 9's acceptance bar (slow: three engine warmups incl. the CPU
+    int8 reference lowering): w8a8 >= 1.2x f32 img/s on the v5e roofline
+    proxy at the smallest bucket, top-1 agreement >= 0.99 and max-abs
+    logit drift within KDLT_QUANT_TOL on the golden fixture, and the
+    engine's own warmup tolerance gate ACCEPTED the calibrated artifact
+    (measured CPU img/s is reported alongside -- XLA:CPU has no s8xs8
+    fast path, so the device claim rides the proxy + the gate numerics)."""
+    bench = _bench_module()
+    out, rc = bench.bench_quant_ab(
+        reps=2, size=32, buckets=(1, 2), calib_images=16,
+        percentile=100.0, min_size=700_000,
+    )
+    assert rc == 0, out
+    assert out["value"] >= 1.2, out
+    assert out["gate_accepted"] is True, out
+    assert out["top1_agreement"] >= 0.99, out
+    assert out["worst_rel_maxabs_drift"] <= out["tol"], out
+    # Weight bytes: the roofline's numerator is real, not assumed.  This
+    # config confines int8 to the three biggest kernels (CPU economy), so
+    # the drop is partial; the full-ladder ~4x is pinned by
+    # test_quantize.py's artifact-size assertion.
+    f32_b = next(iter(out["arms"]["f32"]["buckets"].values()))["weight_bytes"]
+    w8a8_b = next(iter(out["arms"]["w8a8"]["buckets"].values()))["weight_bytes"]
+    assert w8a8_b < f32_b * 0.85, (f32_b, w8a8_b)

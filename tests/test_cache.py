@@ -557,3 +557,133 @@ def test_e2e_client_sees_cache_dispositions_and_bust(tmp_path):
         gw.shutdown()
         server.shutdown()
         httpd.shutdown()
+
+
+# --- negative caching (ISSUE 9 satellite / ROADMAP cache follow-on #1) ------
+
+
+def test_negative_cache_put_lookup_expiry_and_5xx_refusal():
+    c = cache_lib.ResponseCache(ttl_s=60.0, max_mb=1.0, neg_ttl_s=0.05)
+    # 404/400 are storable under the negative TTL; 5xx never.
+    assert c.storable_status(200) and c.storable_status(404)
+    assert c.storable_status(400)
+    for status in (500, 502, 503, 504):
+        assert not c.storable_status(status)
+        assert c.put("k5", b"boom", "t", "m", "h", status=status) is False
+    assert c.lookup("k5") is None
+    # A stored 404 answers with ITS status and counts as a negative hit.
+    assert c.put("k", b'{"error":"no"}', "application/json", "m", "h",
+                 status=404) is True
+    assert c.lookup("k") == (404, b'{"error":"no"}', "application/json")
+    assert c.negative_hits == 1 and c.hits == 1
+    assert c.stats()["negative_entries"] == 1
+    assert c.stats()["negative_hits"] == 1
+    # ...and expires on the SHORT ttl, not the positive one.
+    time.sleep(0.06)
+    assert c.lookup("k") is None
+    assert c.evictions["ttl"] == 1
+    # A positive entry under the same clock survives (ttl_s=60).
+    c.put("pos", b"ok", "t", "m", "h")
+    time.sleep(0.06)
+    assert c.lookup("pos") == (200, b"ok", "t")
+
+
+def test_negative_cache_disabled_when_ttl_zero():
+    c = cache_lib.ResponseCache(ttl_s=60.0, max_mb=1.0, neg_ttl_s=0.0)
+    assert not c.storable_status(404)
+    assert c.put("k", b"x", "t", "m", "h", status=404) is False
+    # 200s still cache normally.
+    assert c.put("k", b"x", "t", "m", "h") is True
+
+
+def test_negative_cache_metrics_minted_centrally():
+    reg = metrics_lib.Registry()
+    c = cache_lib.ResponseCache(registry=reg, ttl_s=60.0, max_mb=1.0,
+                                neg_ttl_s=5.0)
+    c.put("k", b"e", "t", "m", "h", status=400)
+    c.lookup("k")
+    page = reg.render()
+    assert "kdlt_cache_negative_hits_total 1" in page
+
+
+def _failing_fetch_gateway(neg_ttl_s, fail_with=None, **kw):
+    """A stub gateway whose image fetch always fails (the hammered-bad-URL
+    scenario); ``fetches`` is the cost ground truth."""
+    from kubernetes_deep_learning_tpu.serving.gateway import UpstreamError
+
+    gw = Gateway(
+        serving_host="127.0.0.1:1", model="stub-model", bind=False,
+        cache_neg_ttl_s=neg_ttl_s, **kw
+    )
+    fetches = {"n": 0}
+
+    def fake_fetch(url):
+        fetches["n"] += 1
+        if fail_with is not None:
+            raise fail_with
+        raise ValueError("404 Not Found fetching image")
+
+    gw._fetch_one = fake_fetch
+    return gw, fetches
+
+
+def test_gateway_negative_caches_repeated_bad_url():
+    gw, fetches = _failing_fetch_gateway(neg_ttl_s=5.0)
+    try:
+        body = json.dumps({"url": "http://img/broken.png"}).encode()
+        s1, out1, _, h1 = gw.handle_predict(body, "rid-1")
+        assert s1 == 400
+        assert h1[cache_lib.CACHE_STATUS_HEADER] == "miss"
+        s2, out2, _, h2 = gw.handle_predict(body, "rid-2")
+        assert s2 == 400 and out2 == out1
+        assert h2[cache_lib.CACHE_STATUS_HEADER] == "hit"
+        assert fetches["n"] == 1  # the hammered bad URL paid the path ONCE
+        assert gw.cache.negative_hits == 1
+        # A different URL is its own identity.
+        s3, _, _, h3 = gw.handle_predict(
+            json.dumps({"url": "http://img/other.png"}).encode(), "rid-3"
+        )
+        assert s3 == 400 and h3[cache_lib.CACHE_STATUS_HEADER] == "miss"
+        assert fetches["n"] == 2
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_negative_cache_expires_and_disabled_posture():
+    gw, fetches = _failing_fetch_gateway(neg_ttl_s=0.05)
+    try:
+        body = json.dumps({"url": "http://img/broken.png"}).encode()
+        gw.handle_predict(body, "rid-1")
+        time.sleep(0.06)
+        _, _, _, h2 = gw.handle_predict(body, "rid-2")
+        assert h2[cache_lib.CACHE_STATUS_HEADER] == "miss"
+        assert fetches["n"] == 2  # expired: the bad URL is re-checked
+    finally:
+        gw.shutdown()
+    gw, fetches = _failing_fetch_gateway(neg_ttl_s=0.0)
+    try:
+        body = json.dumps({"url": "http://img/broken.png"}).encode()
+        gw.handle_predict(body, "rid-1")
+        _, _, _, h2 = gw.handle_predict(body, "rid-2")
+        assert h2[cache_lib.CACHE_STATUS_HEADER] == "miss"
+        assert fetches["n"] == 2  # negative caching off: full path per hit
+    finally:
+        gw.shutdown()
+
+
+def test_gateway_never_negative_caches_5xx():
+    from kubernetes_deep_learning_tpu.serving.gateway import UpstreamError
+
+    gw, fetches = _failing_fetch_gateway(
+        neg_ttl_s=5.0, fail_with=UpstreamError("replica down", http_status=502)
+    )
+    try:
+        body = json.dumps({"url": "http://img/x.png"}).encode()
+        s1, _, _, _ = gw.handle_predict(body, "rid-1")
+        s2, _, _, h2 = gw.handle_predict(body, "rid-2")
+        assert (s1, s2) == (502, 502)
+        assert h2[cache_lib.CACHE_STATUS_HEADER] == "miss"
+        assert fetches["n"] == 2  # a transient upstream failure is never replayed
+        assert gw.cache.stats()["entries"] == 0
+    finally:
+        gw.shutdown()

@@ -150,3 +150,26 @@ def test_lint_flags_bounded_window_and_class_labels_outside_central():
     assert check_metrics.lint_source(
         'reg.with_labels(window="5m")\n', _METRICS_PATH
     ) == []
+
+
+def test_lint_flags_quant_series_minted_outside_central_module():
+    src = 'reg.gauge("kdlt_quant_scheme", "stray")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "kdlt_quant_scheme" in v and "central" in v
+    src = 'reg.counter("kdlt_quant_gate_failures_total", "stray")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "central" in v
+    # The central module itself mints them.
+    assert check_metrics.lint_source(
+        'reg.gauge("kdlt_quant_scheme", "ok")\n',
+        os.path.join("kubernetes_deep_learning_tpu", "utils", "metrics.py"),
+    ) == []
+
+
+def test_lint_flags_scheme_label_outside_central():
+    src = 'reg.with_labels(scheme="int8-w8a8")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "scheme" in v and "central" in v
+    assert check_metrics.lint_source(
+        src, os.path.join("kubernetes_deep_learning_tpu", "utils", "metrics.py")
+    ) == []

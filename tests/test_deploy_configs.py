@@ -456,6 +456,77 @@ def test_gateway_cache_envs_agree_across_k8s_and_compose():
     assert float(k8s_env[MAX_MB_ENV]) > 0, "byte budget wired off"
 
 
+def test_quant_envs_agree_across_k8s_and_compose():
+    """The full-int8 serving wiring (ISSUE 9): the model tier carries
+    KDLT_QUANT_TOL + KDLT_QUANT_SCHEME in BOTH deploy targets (and on both
+    compose replicas) with values the code accepts, and every copy agrees
+    -- a replica with a looser tolerance bound would activate a w8a8
+    program its siblings refused, and the gateway fails over between
+    them."""
+    from kubernetes_deep_learning_tpu.ops.quantize import (
+        QUANT_SCHEME_ENV,
+        QUANT_TOL_ENV,
+        resolve_quant_tol,
+        resolve_scheme_override,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (container,) = model_dep["spec"]["template"]["spec"]["containers"]
+    k8s_env = {e["name"]: str(e.get("value", "")) for e in container["env"]}
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    envs = {"k8s/model-server": k8s_env}
+    for svc in ("model-server", "model-server-b"):
+        envs[f"compose/{svc}"] = {
+            k: str(v)
+            for k, v in compose["services"][svc]["environment"].items()
+        }
+    for var in (QUANT_TOL_ENV, QUANT_SCHEME_ENV):
+        values = {where: env.get(var) for where, env in envs.items()}
+        assert all(v is not None for v in values.values()), (
+            f"{var} missing from some model tier: {values}"
+        )
+        assert len(set(values.values())) == 1, (
+            f"{var} disagrees across the model tiers: {values}"
+        )
+    # The values must parse as a usable configuration through the same
+    # resolvers the engine uses.
+    tol = float(k8s_env[QUANT_TOL_ENV])
+    assert 0.0 < tol < 1.0, "tolerance gate wired to a nonsense bound"
+    os.environ[QUANT_TOL_ENV] = k8s_env[QUANT_TOL_ENV]
+    os.environ[QUANT_SCHEME_ENV] = k8s_env[QUANT_SCHEME_ENV]
+    try:
+        assert resolve_quant_tol() == tol
+        assert resolve_scheme_override() == "auto", (
+            "deploys must not ship the weight-only rollback knob engaged"
+        )
+    finally:
+        del os.environ[QUANT_TOL_ENV]
+        del os.environ[QUANT_SCHEME_ENV]
+
+
+def test_gateway_negative_cache_ttl_wired():
+    """Negative caching (ROADMAP cache follow-on #1): both gateway deploys
+    carry KDLT_CACHE_NEG_TTL_S, agreeing, positive (the feature is ON in
+    production), and within the positive TTL (a negative entry must never
+    outlive a positive one)."""
+    from kubernetes_deep_learning_tpu.serving.cache import NEG_TTL_ENV, TTL_ENV
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    (container,) = gw_dep["spec"]["template"]["spec"]["containers"]
+    k8s_env = {e["name"]: str(e.get("value", "")) for e in container["env"]}
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    compose_env = {
+        k: str(v)
+        for k, v in compose["services"]["gateway"]["environment"].items()
+    }
+    assert NEG_TTL_ENV in k8s_env and NEG_TTL_ENV in compose_env
+    assert k8s_env[NEG_TTL_ENV] == compose_env[NEG_TTL_ENV]
+    neg = float(k8s_env[NEG_TTL_ENV])
+    assert 0 < neg <= float(k8s_env[TTL_ENV])
+
+
 def test_model_server_hpa_scales_on_minted_serving_signals():
     """The model-tier HPA (ROADMAP multi-model gap #4) must scale on metric
     names the serving path actually mints: every metric named in the HPA

@@ -164,3 +164,45 @@ def test_fast_compile_failure_degrades_to_exact_graph(engine):
         got = eng.predict(x)
         want = np.asarray(jax.jit(build_forward(spec, dtype=None))(variables, x))
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_donation_engaged_on_every_bucket(engine):
+    # The donation audit's regression surface (ISSUE 9): every bucket's
+    # compiled forward donates the batch argument (Lowered.args_info is
+    # trace+lower only -- no XLA compile), and NEVER the variables --
+    # donating the weights would free them under the next request.
+    eng, _, _ = engine
+    for b in eng.buckets:
+        info = eng.donation_info(b)
+        assert info["images"] is True, f"bucket {b}: batch not donated"
+        assert info["variables"] is False, f"bucket {b}: variables donated!"
+
+
+@pytest.mark.slow  # one extra full-engine compile
+def test_donated_logits_bit_identical_to_nondonated(engine):
+    # Donation is a memory-lifetime annotation, not a numerics change: the
+    # same forward jitted WITHOUT donate_argnums must produce bit-identical
+    # logits for the same batch.
+    import jax
+    import jax.numpy as jnp
+
+    eng, _, spec = engine
+    x = np.random.default_rng(5).integers(
+        0, 256, size=(1, *spec.input_shape), dtype=np.uint8
+    )
+    donated = eng.predict(x)
+    plain = jax.jit(eng._live_forward(jnp.dtype(eng._compute_dtype)))
+    want = np.asarray(plain(eng._variables, x))[:1]
+    assert np.array_equal(donated, want)
+
+
+def test_donation_env_kill_switch(monkeypatch):
+    # KDLT_DONATE=0 must build a non-donating program (the A/B lever the
+    # bit-identity contract above is verified against on real devices).
+    from kubernetes_deep_learning_tpu.runtime.engine import donation_enabled
+
+    assert donation_enabled() is True
+    monkeypatch.setenv("KDLT_DONATE", "0")
+    assert donation_enabled() is False
+    monkeypatch.delenv("KDLT_DONATE")
+    assert donation_enabled(False) is False

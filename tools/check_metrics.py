@@ -25,8 +25,9 @@ Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
   utils.metrics.model_registry / model_version_registry and friends, which
   is what keeps its cardinality BOUNDED (MODEL_LABEL_CAP + the overflow
   bucket) no matter what names a caller feeds in.  The same rule covers
-  the other bounded labels: ``window`` (the SLO engine's fixed window set)
-  and ``class`` (the tracer's retention classes);
+  the other bounded labels: ``window`` (the SLO engine's fixed window set),
+  ``class`` (the tracer's retention classes), ``reason`` (cache eviction
+  reasons), and ``scheme`` (the quantization scheme list);
 - ``kdlt_slo_*`` series must be minted inside utils/metrics.py: the SLO
   engine's gauge matrix is (bounded model) x (fixed window), and a module
   minting its own slice would bypass both bounds at once;
@@ -49,13 +50,15 @@ MINT_METHODS = {"counter", "gauge", "histogram"}
 METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # Labels whose value sets are bounded by construction inside utils/metrics.py
 # (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
-# the trace retention classes; reason: the cache eviction reasons) --
-# attaching them anywhere else escapes the bound.
-CENTRAL_LABELS = {"model", "window", "class", "reason"}
+# the trace retention classes; reason: the cache eviction reasons; scheme:
+# the quantization scheme list) -- attaching them anywhere else escapes the
+# bound.
+CENTRAL_LABELS = {"model", "window", "class", "reason", "scheme"}
 # Series prefixes whose minting is confined to utils/metrics.py even beyond
-# the general helper conventions (the SLO gauge matrix and the response
-# cache's series: both carry bounded labels a stray mint would escape).
-CENTRAL_PREFIXES = ("kdlt_slo_", "kdlt_cache_")
+# the general helper conventions (the SLO gauge matrix, the response
+# cache's series, and the quantization scheme/gate series: all carry
+# bounded labels a stray mint would escape).
+CENTRAL_PREFIXES = ("kdlt_slo_", "kdlt_cache_", "kdlt_quant_")
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
 SKIP_PARTS = {"tfs_gen", "__pycache__"}
 
@@ -191,9 +194,9 @@ def lint_source(src: str, rel: str) -> list[str]:
             ):
                 violations.append(
                     f"{rel}:{node.lineno}: {head!r} minted outside "
-                    "utils/metrics.py; kdlt_slo_*/kdlt_cache_* series are "
-                    "minted only by the central helpers (bounded label sets "
-                    "by construction)"
+                    "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_* "
+                    "series are minted only by the central helpers (bounded "
+                    "label sets by construction)"
                 )
     return violations
 
