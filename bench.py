@@ -2245,6 +2245,310 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
     return out, 0 if ok else 1
 
 
+def bench_churn_ab(duration_s=10.0, device_ms=40.0, deadline_ms=1000.0,
+                   rate_rps=32.0, hedge_delay_ms=400.0, probe_interval_s=0.25,
+                   resolve_interval_s=0.35, join_at_frac=0.35,
+                   leave_at_frac=0.55, seed=0):
+    """Elastic-fleet churn A/B: replicas JOIN and LEAVE mid-run under load.
+
+    The dynamic-membership acceptance harness (serving/upstream.py
+    set_membership + quarantine + drain watch, ISSUE 11).  A REAL Gateway
+    fronts stub-backed ModelServer replicas whose membership comes from an
+    injected resolver (the bench stand-in for re-resolving a headless
+    Service name); an open-loop client fires deadline-carrying /predict
+    requests at ``rate_rps`` -- sized so TWO replicas hold the load
+    comfortably and ONE collapses (~1.5x a single replica's capacity, the
+    "2x load" regime relative to the post-leave survivor).  Mid-run, two
+    membership events:
+
+    - t+``join_at_frac``: replica C (already warm -- the kdlt-warm story
+      makes that the normal case) appears in the resolved view.  It must
+      enter via health-probe QUARANTINE and take primaries only after its
+      first /readyz 200.
+    - t+``leave_at_frac``: replica A is SIGTERM'd (begin_drain: /readyz
+      flips, in-flight completes) and simultaneously drops out of the
+      resolved view -- the k8s scale-down sequence.  Nothing in flight on
+      A may be dropped.
+
+    Baseline arm: the same gateway with a STATIC host list {A, B} (no
+    resolver; failover/hedging still on -- membership is the variable
+    under test, not failover).  It never learns about C, so after A
+    leaves the survivor B carries ~1.5x its capacity and goodput decays;
+    the churn arm rides B+C and holds.
+
+    Returns (json_dict, rc); rc=0 iff the churn arm keeps >= 95%
+    in-deadline goodput overall (through BOTH membership changes), the
+    joiner demonstrably served primaries after quarantine release, ZERO
+    requests failed in the leave window, the pool's join/leave counters
+    minted, and the churn arm beats the static baseline.
+    """
+    import re
+    import tempfile
+    import threading
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    import requests
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    spec = register_spec(
+        ModelSpec(
+            name="churn-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    deadline_s = deadline_ms / 1e3
+    n_requests = int(duration_s * rate_rps)
+    join_after_s = join_at_frac * duration_s
+    leave_after_s = leave_at_frac * duration_s
+    rng = np.random.default_rng(seed)
+    img_dir = tempfile.mkdtemp(prefix="kdlt-churn-img-")
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(img_dir, "img.png"))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    img_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+    log(
+        f"churn A/B: stub replicas ({device_ms}ms/batch), {rate_rps:g} "
+        f"req/s x {duration_s}s = {n_requests} requests, deadline "
+        f"{deadline_ms:.0f}ms, C joins at t+{join_after_s:.1f}s, A drains "
+        f"out at t+{leave_after_s:.1f}s, resolve {resolve_interval_s:g}s, "
+        f"probe {probe_interval_s:g}s, hedge {hedge_delay_ms:.0f}ms"
+    )
+
+    def start_replica() -> ModelServer:
+        root = tempfile.mkdtemp(prefix="kdlt-churn-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        # Bucket 1 ONLY: with bucket 2 in the ladder a backlogged replica
+        # doubles its throughput by batching, and a single survivor absorbs
+        # the whole offered load -- the capacity cliff this A/B needs is
+        # one request per device_ms.
+        server = ModelServer(
+            root, port=0, buckets=(1,), max_delay_ms=1.0, host="127.0.0.1",
+            engine_factory=lambda a, **kw: StubEngine(
+                a, device_ms_per_batch=device_ms, **kw
+            ),
+        )
+        server.warmup()
+        server.start()
+        return server
+
+    def run_arm(churn: bool) -> dict:
+        a, b = start_replica(), start_replica()
+        c = start_replica() if churn else None
+        host_a, host_b = f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"
+        view = [host_a, host_b]  # the resolver's mutable membership view
+        gw = Gateway(
+            serving_host=f"{host_a},{host_b}",
+            model=spec.name, port=0, host="127.0.0.1",
+            failover=True,
+            hedge_delay_ms=hedge_delay_ms,
+            probe_interval_s=probe_interval_s,
+            pool_resolve_s=resolve_interval_s if churn else 0,
+            # One repeated URL: the response cache would absorb everything
+            # after the first request (--cache-ab owns that A/B).
+            cache=False,
+        )
+        if churn:
+            # The bench stand-in for DNS: membership IS this list.
+            gw.pool.resolver = lambda: list(view)
+        gw.start()
+        gw.spec  # discover the contract before the clock starts
+        url = f"http://127.0.0.1:{gw.port}/predict"
+        session = requests.Session()
+        session.mount("http://", requests.adapters.HTTPAdapter(
+            pool_connections=4, pool_maxsize=256,
+        ))
+        results: list = [None] * n_requests
+
+        def fire(i: int, at: float) -> None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                r = session.post(
+                    url, json={"url": img_url},
+                    headers={DEADLINE_HEADER: f"{deadline_ms:.1f}"},
+                    timeout=deadline_s + 5.0,
+                )
+                status = r.status_code
+            except Exception:
+                status = -1
+            results[i] = (time.monotonic() - at, status)
+
+        t_base = time.monotonic() + 0.25
+        join_at = t_base + join_after_s
+        leave_at = t_base + leave_after_s
+        threads = [
+            threading.Thread(
+                target=fire, args=(i, t_base + i / rate_rps), daemon=True
+            )
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+
+        def stage_join() -> None:
+            delay = join_at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            view.append(f"127.0.0.1:{c.port}")
+
+        def stage_leave() -> None:
+            delay = leave_at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            # The k8s scale-down sequence: SIGTERM (drain begins, /readyz
+            # flips -- the drain watch pulls A from new-primary rotation)
+            # and the endpoint leaves DNS; the process exits only after
+            # in-flight work completes.
+            a.begin_drain()
+            if churn:
+                view.remove(host_a)
+            time.sleep(min(1.2, 2 * deadline_s))
+            a.shutdown()
+
+        stagers = [threading.Thread(target=stage_leave, daemon=True)]
+        if churn:
+            stagers.append(threading.Thread(target=stage_join, daemon=True))
+        for t in stagers:
+            t.start()
+        end_by = t_base + duration_s + max(2.0, 2 * deadline_s)
+        for t in threads:
+            t.join(timeout=max(0.0, end_by - time.monotonic()))
+        for t in stagers:
+            t.join(timeout=10.0)
+        gw_metrics = gw.registry.render()
+        pool_debug = gw.pool.debug_payload()
+        gw.shutdown()
+        b.shutdown()
+        if c is not None:
+            c.shutdown()
+
+        sched = [t_base + i / rate_rps for i in range(n_requests)]
+        done = [
+            (sched[i], lat, status)
+            for i, r in enumerate(results) if r is not None
+            for lat, status in [r]
+        ]
+
+        def window_rate(lo: float, hi: float) -> tuple[float, int]:
+            """(in-deadline rate, DROPPED count) for requests scheduled in
+            [lo, hi).  Dropped = non-200 (connection died, shed, error);
+            a late-but-successful response is a goodput miss, not a drop
+            -- the zero-drop leave gate is about work, not latency."""
+            win = [(lat, st) for at, lat, st in done if lo <= at < hi]
+            ok = [1 for lat, st in win if st == 200 and lat <= deadline_s]
+            drops = [1 for _, st in win if st != 200]
+            return round(len(ok) / max(1, len(win)), 4), len(drops)
+
+        in_deadline = [
+            1 for _, lat, st in done if st == 200 and lat <= deadline_s
+        ]
+        # The leave window: requests scheduled around the drain+departure.
+        leave_rate, leave_drops = window_rate(
+            leave_at - 0.5, leave_at + 1.5
+        )
+        join_rate, _ = window_rate(join_at - 0.5, join_at + 1.5)
+        post_leave_rate, _ = window_rate(leave_at, t_base + duration_s)
+
+        def metric(name: str) -> float:
+            m = re.search(rf"^{name}(?:\{{[^}}]*\}})? (\S+)$", gw_metrics, re.M)
+            return float(m.group(1)) if m else 0.0
+
+        joiner_picks = 0
+        if c is not None:
+            for rep in pool_debug["replicas"]:
+                if rep["host"] == f"127.0.0.1:{c.port}":
+                    joiner_picks = rep["picks"]
+        arm = {
+            "churn": churn,
+            "requests": n_requests,
+            "resolved": len(done),
+            "in_deadline_rate": round(
+                len(in_deadline) / max(1, len(done)), 4
+            ),
+            "join_window_in_deadline_rate": join_rate if churn else None,
+            "leave_window_in_deadline_rate": leave_rate,
+            "leave_window_drops": leave_drops,
+            "post_leave_in_deadline_rate": post_leave_rate,
+            "members_final": pool_debug["members"],
+            "pool_joins_total": metric("kdlt_pool_joins_total"),
+            "pool_leaves_total": metric("kdlt_pool_leaves_total"),
+            "pool_members_gauge": metric("kdlt_pool_members"),
+            "joiner_picks": joiner_picks,
+            "failover_total": metric("kdlt_upstream_failover_total"),
+            "hedge_fired_total": metric("kdlt_hedge_fired_total"),
+        }
+        log(
+            f"  {'churn   ' if churn else 'baseline'}: "
+            f"{arm['in_deadline_rate'] * 100:5.1f}% in-deadline overall, "
+            f"leave window {leave_rate * 100:5.1f}% "
+            f"({leave_drops} dropped), post-leave "
+            f"{post_leave_rate * 100:5.1f}%, members={arm['members_final']}"
+            + (
+                f", joins={arm['pool_joins_total']:.0f} "
+                f"leaves={arm['pool_leaves_total']:.0f} "
+                f"joiner_picks={joiner_picks}" if churn else ""
+            )
+        )
+        return arm
+
+    try:
+        arm_churn = run_arm(True)
+        arm_base = run_arm(False)
+    finally:
+        img_httpd.shutdown()
+    ok = (
+        arm_churn["in_deadline_rate"] >= 0.95
+        and arm_churn["pool_joins_total"] >= 1
+        and arm_churn["pool_leaves_total"] >= 1
+        and arm_churn["joiner_picks"] > 0
+        and arm_churn["leave_window_drops"] == 0
+        and arm_churn["in_deadline_rate"] > arm_base["in_deadline_rate"]
+    )
+    out = {
+        "metric": (
+            f"elastic-fleet churn A/B (C joins at t+{join_after_s:.1f}s, A "
+            f"drains out at t+{leave_after_s:.1f}s of {duration_s:g}s, "
+            f"{deadline_ms:.0f}ms deadline, {rate_rps:g} req/s): in-deadline "
+            "goodput with dynamic membership vs a static {A,B} list"
+        ),
+        "value": round(arm_churn["in_deadline_rate"], 4),
+        "unit": "in-deadline success rate (dynamic membership)",
+        "vs_baseline": round(
+            arm_churn["in_deadline_rate"]
+            / max(arm_base["in_deadline_rate"], 1e-9),
+            2,
+        ),
+        "deadline_ms": deadline_ms,
+        "rate_rps": rate_rps,
+        "hedge_delay_ms": hedge_delay_ms,
+        "probe_interval_s": probe_interval_s,
+        "resolve_interval_s": resolve_interval_s,
+        "seed": seed,
+        "arms": {"churn": arm_churn, "static_baseline": arm_base},
+    }
+    return out, 0 if ok else 1
+
+
 def bench_quant_ab(reps=3, size=32, buckets=(1, 2), calib_images=8,
                    percentile=None, seed=0, min_size=4096, tol=None):
     """f32 vs int8-weight-only vs int8-w8a8 on the REAL engine path.
@@ -3364,6 +3668,52 @@ def main() -> int:
              "mark it out on the FIRST observation",
     )
     p.add_argument(
+        "--churn-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: elastic-fleet churn A/B -- front stub "
+             "model-tier replicas with the real gateway under dynamic "
+             "membership (injected resolver), have a warm replica JOIN "
+             "mid-run (quarantine until its first /readyz 200) and "
+             "another DRAIN OUT (SIGTERM + DNS departure), vs a static "
+             "host-list baseline that never learns about either (no "
+             "device needed; rc=0 iff the churn arm holds >=95% "
+             "in-deadline goodput through both membership changes, the "
+             "joiner served primaries, zero requests failed in the leave "
+             "window, and it beats the baseline)",
+    )
+    p.add_argument(
+        "--churn-device-ms", type=float, default=40.0,
+        help="simulated device ms per batch for the --churn-ab stub "
+             "replicas (sets per-replica capacity; the offered rate "
+             "should overload ONE replica but not two)",
+    )
+    p.add_argument(
+        "--churn-deadline-ms", type=float, default=1000.0,
+        help="per-request deadline budget for --churn-ab",
+    )
+    p.add_argument(
+        "--churn-rate-rps", type=float, default=32.0,
+        help="offered request rate for --churn-ab (~1.5x one replica's "
+             "capacity at the default device-ms)",
+    )
+    p.add_argument(
+        "--churn-hedge-ms", type=float, default=400.0,
+        help="hedge delay for --churn-ab (both arms)",
+    )
+    p.add_argument(
+        "--churn-probe-s", type=float, default=0.25,
+        help="replica probe interval for --churn-ab (quarantine release "
+             "and drain-watch latency are bounded by this)",
+    )
+    p.add_argument(
+        "--churn-resolve-s", type=float, default=0.35,
+        help="membership re-resolve interval for the --churn-ab churn arm "
+             "(the KDLT_POOL_RESOLVE_S knob)",
+    )
+    p.add_argument(
+        "--churn-seed", type=int, default=0,
+        help="deterministic seed for the --churn-ab request schedule",
+    )
+    p.add_argument(
         "--cache-ab", type=float, default=0, metavar="SECONDS",
         help="INSTEAD of the sweep: gateway cache+singleflight A/B -- "
              "drive a real gateway + stub model tier with a Zipf-"
@@ -3490,7 +3840,7 @@ def main() -> int:
         mode = "sweep"
         for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
                      "batcher_sweep", "host_saturation", "overload_ab",
-                     "chaos_ab", "cache_ab", "trace_breakdown",
+                     "chaos_ab", "churn_ab", "cache_ab", "trace_breakdown",
                      "multimodel_ab", "obs_overhead_ab", "quant_ab"):
             if getattr(args, flag):
                 mode = flag
@@ -3521,6 +3871,16 @@ def main() -> int:
                 "probe_s": args.chaos_probe_s,
                 "seed": args.chaos_seed,
                 "mode": args.chaos_mode,
+            },
+            "churn": {
+                "duration_s": args.churn_ab,
+                "device_ms": args.churn_device_ms,
+                "deadline_ms": args.churn_deadline_ms,
+                "rate_rps": args.churn_rate_rps,
+                "hedge_ms": args.churn_hedge_ms,
+                "probe_s": args.churn_probe_s,
+                "resolve_s": args.churn_resolve_s,
+                "seed": args.churn_seed,
             },
             "quant": {
                 "reps": args.quant_ab,
@@ -3671,6 +4031,20 @@ def main() -> int:
             probe_interval_s=args.chaos_probe_s,
             seed=args.chaos_seed,
             mode=args.chaos_mode,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.churn_ab > 0:
+        out, rc = bench_churn_ab(
+            duration_s=args.churn_ab,
+            device_ms=args.churn_device_ms,
+            deadline_ms=args.churn_deadline_ms,
+            rate_rps=args.churn_rate_rps,
+            hedge_delay_ms=args.churn_hedge_ms,
+            probe_interval_s=args.churn_probe_s,
+            resolve_interval_s=args.churn_resolve_s,
+            seed=args.churn_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

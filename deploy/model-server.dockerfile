@@ -42,6 +42,17 @@ RUN pip install --no-cache-dir -c requirements.lock ".[grpc]"
 # the reference bakes its SavedModel with (tf-serving.dockerfile:5).
 COPY models /models
 
+# Bake a hot XLA compile cache into the image layer (zero-cold-start
+# scale-up, GUIDE 10k): AOT-compile every baked model's full bucket ladder
+# NOW so each pod this image ever starts warms from disk -- cache hits in
+# seconds, exactly when the HPA added the pod because load spiked.  Cache
+# keys include the target platform and the build host has no TPU, so this
+# bakes the cpu programs; TPU pods pre-fill their shared cache volume at
+# init instead (KDLT_AOT_WARM=1, model-server-deployment.yaml).  Fail-soft:
+# a warm failure costs cold-start time, never the image build.
+RUN kdlt-warm --models /models --compile-cache-dir /var/cache/kdlt-xla --platform cpu || \
+    echo "kdlt-warm: bake failed; pods will compile at first warmup" >&2
+
 # 8500 = msgpack/JSON HTTP (probes, gateway); 8501 = the reference's
 # exact gRPC PredictionService wire (serving/grpc_predict.py) so
 # TF-Serving-era clients work against this tier unmodified.

@@ -1,0 +1,177 @@
+"""kdlt-warm: AOT-compile every registry model's bucket ladder into the
+persistent compile cache (zero-cold-start scale-up).
+
+BENCH_r05 measured 7-28 s of live XLA compile per bucket, which makes a
+freshly scaled model-server pod dead weight exactly when the HPA added it
+because load spiked.  The persistent compile cache (utils.compilecache,
+GUIDE §10b) already makes a RE-compile a disk read; what was missing is
+anything that fills the cache BEFORE the first pod boots.  This CLI is
+that filler, with two call sites:
+
+- **image build**: ``RUN kdlt-warm --models /models --compile-cache-dir
+  /var/cache/kdlt-xla`` in the serving Dockerfile bakes a hot cache into
+  the image layer, so every pod the image ever starts warms from disk;
+- **pod init**: ``kdlt-model-server --aot-warm`` (or ``KDLT_AOT_WARM=1``
+  on an init container sharing the cache volume) runs the same pass
+  against a persistent volume before serving starts.
+
+Either way, a scaled pod's ``InferenceEngine.warmup()`` is cache-hits
+only -- ``kdlt_engine_warm_source{source="compile"} == 0`` is the proof
+-- while readiness stays gated on all-buckets-warm exactly as before.
+
+The scan rule is shared with the serving registry
+(serving.registry.iter_latest_versions): the set of models pre-warmed is
+exactly the set a booted server would load.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from kubernetes_deep_learning_tpu.utils import compilecache
+
+# The model-server image's cache mount (deploy/k8s +
+# deploy/model-server.dockerfile agree on this path).
+DEFAULT_CACHE_DIR = "/var/cache/kdlt-xla"
+
+
+def warm_models(
+    model_root: str,
+    buckets=None,
+    cache_dir: str | None = None,
+    workers: int = 4,
+    engine_factory=None,
+) -> dict:
+    """Warm every model under ``model_root``; returns the report dict.
+
+    One engine per model's latest version, full bucket ladder (the
+    DEFAULT_BUCKETS every serving pod compiles), warmup() per engine --
+    the compiled programs land in the persistent cache as a side effect.
+    ``engine_factory`` swaps the engine class (tests); the default is the
+    serving InferenceEngine, so the programs cached here are bit-the-same
+    programs a pod will look up.
+    """
+    from kubernetes_deep_learning_tpu.runtime import engine as engine_lib
+    from kubernetes_deep_learning_tpu.serving.registry import (
+        iter_latest_versions,
+    )
+
+    resolved = compilecache.enable_compile_cache(
+        cache_dir, default_dir=DEFAULT_CACHE_DIR
+    )
+    factory = engine_factory or _default_factory
+    report: dict = {
+        "cache_dir": resolved,
+        "buckets": list(buckets or engine_lib.DEFAULT_BUCKETS),
+        "models": {},
+    }
+    for name, version, directory in iter_latest_versions(model_root):
+        t0 = time.perf_counter()
+        try:
+            engine = factory(
+                directory, buckets or engine_lib.DEFAULT_BUCKETS
+            )
+            engine.warmup(workers=workers)
+        except Exception as e:  # noqa: BLE001 - warm the REST of the fleet
+            report["models"][name] = {
+                "version": version, "error": str(e),
+            }
+            print(
+                f"kdlt-warm: {name} v{version} FAILED: {e}", file=sys.stderr
+            )
+            continue
+        entry = {
+            "version": version,
+            "seconds": round(time.perf_counter() - t0, 3),
+            **getattr(engine, "warm_report", {}),
+        }
+        report["models"][name] = entry
+        srcs = [
+            b.get("source") for b in entry.get("buckets", {}).values()
+        ] if isinstance(entry.get("buckets"), dict) else []
+        print(
+            f"kdlt-warm: {name} v{version}: {entry['seconds']}s "
+            f"({srcs.count('cache')} cached / {srcs.count('compile')} "
+            "compiled buckets)",
+            file=sys.stderr,
+        )
+    return report
+
+
+def _default_factory(directory: str, buckets):
+    from kubernetes_deep_learning_tpu.export.artifact import load_artifact
+    from kubernetes_deep_learning_tpu.runtime.engine import InferenceEngine
+
+    return InferenceEngine(load_artifact(directory), buckets=buckets)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="AOT-compile every registry model's bucket ladder into "
+        "the persistent compile cache (zero-cold-start scale-up; run at "
+        "image build or pod init)"
+    )
+    p.add_argument(
+        "--models",
+        default=os.environ.get("KDLT_MODEL_ROOT", "/models"),
+        help="artifact root (the model server's --models; default "
+        "$KDLT_MODEL_ROOT or /models)",
+    )
+    p.add_argument(
+        "--buckets",
+        default=None,
+        help="comma-separated bucket ladder override (default: the "
+        "serving DEFAULT_BUCKETS, which is what pods compile)",
+    )
+    p.add_argument(
+        "--compile-cache-dir",
+        default=None,
+        help="persistent compile cache directory (default "
+        f"$KDLT_COMPILE_CACHE_DIR or {DEFAULT_CACHE_DIR})",
+    )
+    p.add_argument(
+        "--workers", type=int, default=4,
+        help="concurrent bucket compiles per model",
+    )
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="force a JAX platform (e.g. cpu) via JAX_PLATFORMS -- an "
+        "image BUILD host usually has no TPU; note cache keys include "
+        "the target platform, so warming on cpu only serves cpu pods",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print the full warm report as JSON on stdout",
+    )
+    args = p.parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    buckets = None
+    if args.buckets:
+        buckets = tuple(
+            sorted({int(b) for b in args.buckets.split(",") if b.strip()})
+        )
+    report = warm_models(
+        args.models,
+        buckets=buckets,
+        cache_dir=args.compile_cache_dir,
+        workers=args.workers,
+    )
+    if args.json:
+        print(json.dumps(report, indent=2))
+    failed = [
+        n for n, m in report["models"].items() if "error" in m
+    ]
+    if not report["models"]:
+        print(f"kdlt-warm: no models under {args.models}", file=sys.stderr)
+        return 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

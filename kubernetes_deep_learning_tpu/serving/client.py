@@ -164,6 +164,44 @@ def fetch_slo(gateway_url: str, timeout: float = 5.0) -> dict:
     return r.json()
 
 
+def fetch_pool(gateway_url: str, timeout: float = 5.0) -> dict:
+    """GET the gateway's /debug/pool view: membership, per-replica
+    health/quarantine/drain state, picks, and the latency EWMA driving
+    power-of-two-choices selection."""
+    import requests
+
+    r = requests.get(f"{gateway_url}/debug/pool", timeout=timeout)
+    r.raise_for_status()
+    return r.json()
+
+
+def render_pool(payload: dict) -> str:
+    """ASCII rendering of a /debug/pool payload: one row per replica --
+    how a scale event rebalances traffic, watched live."""
+    lines = [
+        f"pool: {payload.get('members', 0)} members, "
+        f"{payload.get('joins', 0)} joins, {payload.get('leaves', 0)} "
+        f"leaves (resolve every {payload.get('resolve_interval_s', 0)}s)"
+    ]
+    lines.append(
+        f"{'replica':<28s} {'state':<12s} {'picks':>8s} {'ewma_ms':>9s}"
+    )
+    for row in payload.get("replicas", []):
+        state = (
+            "quarantined" if row.get("quarantined")
+            else "draining" if row.get("draining")
+            else "up" if row.get("healthy")
+            else "DOWN"
+        )
+        ewma = row.get("ewma_ms")
+        ewma_s = f"{ewma:>9.2f}" if ewma is not None else f"{'-':>9s}"
+        lines.append(
+            f"{row.get('host', '?'):<28s} {state:<12s} "
+            f"{row.get('picks', 0):>8d} {ewma_s}"
+        )
+    return "\n".join(lines)
+
+
 def render_slo(payload: dict) -> str:
     """ASCII rendering of a /debug/slo payload: one row per (view, model,
     window), burn rate front and center."""
@@ -238,9 +276,10 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument(
         "--stats", action="store_true",
-        help="after the prediction, print a per-request stats table: the "
-        "gateway's cache disposition (hit/miss/coalesced) and the retry "
-        "counters",
+        help="after the prediction, print a per-request stats table (the "
+        "gateway's cache disposition and the retry counters) plus one "
+        "row per upstream replica from /debug/pool (state, picks, "
+        "latency EWMA)",
     )
     p.add_argument(
         "--trace", action="store_true",
@@ -280,6 +319,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{'stat':<16s} value", file=sys.stderr)
         for name, value in rows:
             print(f"{name:<16s} {value}", file=sys.stderr)
+        # Per-replica rows from /debug/pool: picks + latency EWMA, so an
+        # operator can watch a scale event rebalance traffic.
+        try:
+            print(render_pool(fetch_pool(args.gateway)), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - diagnostics only
+            print(f"# pool fetch failed: {e}", file=sys.stderr)
     if args.trace:
         from kubernetes_deep_learning_tpu.utils.trace import render_waterfall
 

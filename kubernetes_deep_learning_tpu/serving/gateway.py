@@ -58,7 +58,10 @@ from kubernetes_deep_learning_tpu.serving.tracing import (
     ensure_request_id,
     log_request,
 )
-from kubernetes_deep_learning_tpu.serving.upstream import UpstreamPool, parse_hosts
+from kubernetes_deep_learning_tpu.serving.upstream import (
+    UpstreamPool,
+    resolve_serving_host,
+)
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 from kubernetes_deep_learning_tpu.utils import slo as slo_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
@@ -128,6 +131,7 @@ class Gateway:
         cache_ttl_s: float | None = None,
         cache_max_mb: float | None = None,
         cache_neg_ttl_s: float | None = None,
+        pool_resolve_s: float | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -204,13 +208,19 @@ class Gateway:
         # Multi-replica upstream pool (serving.upstream): replica list from
         # the serving host, per-replica health + breaker, hedging policy.
         # With a single replica this degrades to exactly the PR 2 posture
-        # (one breaker, no failover possible).
+        # (one breaker, no failover possible).  Dynamic membership: a
+        # dns+srv:// serving host carries its own resolver; a plain list
+        # re-resolves its DNS names when KDLT_POOL_RESOLVE_S /
+        # --pool-resolve-s asks for it (the pool builds that resolver).
+        hosts, resolver = resolve_serving_host(self.serving_host)
         self.pool = UpstreamPool(
-            parse_hosts(self.serving_host),
+            hosts,
             registry=self.registry,
             failover=failover,
             hedge_delay_ms=hedge_delay_ms,
             probe_interval_s=probe_interval_s,
+            resolver=resolver,
+            resolve_interval_s=pool_resolve_s,
         )
         self.pool.start_probing()
         # Fault injection (serving.faults): the gateway.upstream point;
@@ -753,7 +763,15 @@ class Gateway:
                     # stranding every coalesced flight that dials it.
                     pool.mark_stalled(replica)
             else:
-                pool.record_success(replica)
+                # Feed the replica's latency EWMA (the power-of-two-choices
+                # ranking signal) from the winning response's own timing.
+                elapsed = getattr(r, "elapsed", None)
+                pool.record_success(
+                    replica,
+                    latency_s=(
+                        elapsed.total_seconds() if elapsed is not None else None
+                    ),
+                )
             if r.status_code != 503:
                 break
             last_exc = None
@@ -924,6 +942,16 @@ class Gateway:
                     **self._singleflight.stats(),
                 }
             return 200, json.dumps(payload).encode(), "application/json"
+        if path == "/debug/pool":
+            # The replica pool's operator surface: membership, per-replica
+            # health/quarantine/drain state, picks, and the latency EWMA
+            # driving power-of-two-choices (kdlt-client --stats renders
+            # the per-replica rows from this).
+            return (
+                200,
+                json.dumps(self.pool.debug_payload()).encode(),
+                "application/json",
+            )
         if path.startswith("/debug/trace/"):
             return self.handle_trace(path.rsplit("/", 1)[-1])
         return 404, b'{"error": "not found"}', "application/json"
@@ -1510,6 +1538,16 @@ def main(argv: list[str] | None = None) -> int:
         "replicas (default $KDLT_PROBE_INTERVAL_S or 1.0)",
     )
     p.add_argument(
+        "--pool-resolve-s",
+        type=float,
+        default=None,
+        help="re-resolve the serving host's DNS name(s) every this many "
+        "seconds and apply membership deltas live (joiners quarantined "
+        "until ready, leavers drained); default $KDLT_POOL_RESOLVE_S or "
+        "off.  KDLT_SERVING_HOST=dns+srv://name resolves SRV records "
+        "instead",
+    )
+    p.add_argument(
         "--no-slo",
         action="store_true",
         help="disable the SLO engine (per-model goodput/burn-rate windows, "
@@ -1535,6 +1573,7 @@ def main(argv: list[str] | None = None) -> int:
         probe_interval_s=args.probe_interval_s,
         slo=False if args.no_slo else None,
         cache=False if args.no_cache else None,
+        pool_resolve_s=args.pool_resolve_s,
     )
     # SIGTERM -> flip /readyz, shed new work, finish in-flight, then stop;
     # pairs with the k8s terminationGracePeriodSeconds/preStop settings.

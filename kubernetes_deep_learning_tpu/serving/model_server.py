@@ -87,6 +87,12 @@ _MODEL_RE = re.compile(r"^/v1/models/([^/:]+)$")
 DEFAULT_PORT = 8500  # the reference model tier's port (tf-serving-clothing-model-service.yaml:9-10)
 MAX_IMAGES_PER_REQUEST = 2048  # bounds one request's decoded-image memory
 PROFILE_DIR_ENV = "KDLT_PROFILE_DIR"  # base dir for /debug/profile captures
+# KDLT_AOT_WARM=1: run the kdlt-warm AOT pass (every model, the FULL
+# default bucket ladder, into the persistent compile cache) before
+# serving starts -- the pod-init half of zero-cold-start scale-up; the
+# --aot-warm flag runs the same pass and exits (image build / init
+# container).  See export.warm.
+AOT_WARM_ENV = "KDLT_AOT_WARM"
 
 
 class ServedModel:
@@ -1306,6 +1312,15 @@ def main(argv: list[str] | None = None) -> int:
         "seconds instead of re-paying minutes of bucket warmup (the k8s "
         "deployment mounts a cache volume for exactly this)",
     )
+    p.add_argument(
+        "--aot-warm",
+        action="store_true",
+        help="AOT-compile every model's FULL default bucket ladder into "
+        "the persistent compile cache and EXIT (the kdlt-warm pass; run "
+        "at image build or in an init container sharing the cache "
+        "volume).  $KDLT_AOT_WARM=1 runs the same pass at boot and then "
+        "serves -- either way a scaled pod's warmup is cache-hits only",
+    )
     args = p.parse_args(argv)
 
     from kubernetes_deep_learning_tpu.utils.platform import force_platform
@@ -1317,6 +1332,23 @@ def main(argv: list[str] | None = None) -> int:
     cache_path = enable_compile_cache(args.compile_cache_dir or None)
     if cache_path:
         print(f"persistent compile cache: {cache_path}", file=sys.stderr)
+
+    aot_warm_env = os.environ.get(AOT_WARM_ENV, "").strip().lower() in (
+        "1", "true", "yes",
+    )
+    if args.aot_warm or aot_warm_env:
+        from kubernetes_deep_learning_tpu.export.warm import warm_models
+
+        report = warm_models(
+            args.models, cache_dir=args.compile_cache_dir or None
+        )
+        failed = [n for n, m in report["models"].items() if "error" in m]
+        if args.aot_warm:
+            # Init-container / image-build mode: the pass IS the job.
+            return 1 if failed or not report["models"] else 0
+        # Boot mode (KDLT_AOT_WARM=1): the pass primed the cache for the
+        # FULL ladder; fall through and serve -- this server's own warmup
+        # (possibly over a trimmed --buckets) now hits that cache.
 
     from kubernetes_deep_learning_tpu.utils.distributed import initialize
 

@@ -57,6 +57,28 @@ def artifact_hash(directory: str) -> str:
     return h.hexdigest()
 
 
+def iter_latest_versions(model_root: str) -> list[tuple[str, int, str]]:
+    """Every model's highest numeric version under ``model_root``, as
+    (name, version, directory) tuples in name order.
+
+    THE scan rule -- shared by the serving registry's poll below and the
+    kdlt-warm AOT pass (export.warm) -- so the set of models an image
+    pre-warms is exactly the set a booted server would load.
+    """
+    out: list[tuple[str, int, str]] = []
+    names = (
+        sorted(os.listdir(model_root)) if os.path.isdir(model_root) else []
+    )
+    for name in names:
+        version = art.latest_version(model_root, name)
+        if version is None:
+            continue
+        out.append(
+            (name, version, art.version_dir(model_root, name, version))
+        )
+    return out
+
+
 class ModelRegistry:
     """Scan/compare/swap for every model under one artifact root.
 
@@ -89,19 +111,10 @@ class ModelRegistry:
 
     def _poll_locked(self) -> list[str]:
         updated: list[str] = []
-        names = (
-            sorted(os.listdir(self.model_root))
-            if os.path.isdir(self.model_root)
-            else []
-        )
-        for name in names:
-            version = art.latest_version(self.model_root, name)
-            if version is None:
-                continue
+        for name, version, directory in iter_latest_versions(self.model_root):
             current = self.models.get(name)
             if current is not None and current.version >= version:
                 continue
-            directory = art.version_dir(self.model_root, name, version)
             try:
                 digest = artifact_hash(directory)
             except OSError as e:

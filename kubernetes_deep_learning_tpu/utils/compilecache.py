@@ -61,6 +61,25 @@ def resolve_cache_dir(cache_dir: str | None = None,
     return env or os.environ.get(JAX_ENV_VAR) or default_dir
 
 
+def active_cache_dir() -> str | None:
+    """The cache directory the CURRENT process compiles against, or None.
+
+    Prefers the live jax config (set by :func:`enable_compile_cache` or
+    jax's own env latch at import) and falls back to the env contract for
+    callers probing before jax is imported.  Read-only: never flips the
+    cache on.
+    """
+    try:
+        import jax
+
+        path = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if path:
+            return path
+    except Exception:  # noqa: BLE001 - probing is best-effort
+        pass
+    return resolve_cache_dir(None)
+
+
 def enable_compile_cache(cache_dir: str | None = None, *,
                          default_dir: str | None = None) -> str | None:
     """Enable JAX's persistent compilation cache in THIS process.
@@ -80,6 +99,17 @@ def enable_compile_cache(cache_dir: str | None = None, *,
         jax.config.update("jax_compilation_cache_dir", path)
     except Exception:  # noqa: BLE001 - cache is best-effort by contract
         return None
+    # jax latches an is-the-cache-used verdict per process on the FIRST
+    # compile; a process that compiled anything before this call (bench
+    # preamble, an embedding app) would keep that stale "no" forever and
+    # silently never read or write the cache.  Un-latch it so enabling
+    # mid-process takes effect from the next compile on.
+    try:
+        from jax._src import compilation_cache as _jax_cc
+
+        _jax_cc.reset_cache()
+    except Exception:  # noqa: BLE001 - private surface; absent is fine
+        pass
     # The cache is now ON; the threshold knobs below are tuning only and
     # must not flip the return to None on a jax that lacks them -- a
     # half-enabled-but-reported-disabled cache would desynchronize every

@@ -580,12 +580,77 @@ def quant_metrics(registry: "Registry") -> dict:
     }
 
 
-def replica_healthy_gauge(registry: "Registry", host: str) -> "Gauge":
-    """Per-replica health gauge (1 = routable, 0 = routed around)."""
-    return registry.with_labels(replica=host).gauge(
-        "kdlt_upstream_replica_healthy",
-        "1 while the upstream replica is considered healthy",
-    )
+def pool_membership_metrics(registry: "Registry") -> dict:
+    """Pool-level dynamic-membership series (kdlt_pool_*).
+
+    Minted HERE and nowhere else (tools/check_metrics.py confines the
+    kdlt_pool_ prefix to this module) so the gateway pool and bench.py
+    --churn-ab key one set of names.  ``members`` counts replicas in
+    rotation OR quarantine (everything the resolver currently believes
+    in); joins/leaves count membership transitions, which is what the
+    churn bench's assertions and any flap alert key on.
+    """
+    return {
+        "members": registry.gauge(
+            "kdlt_pool_members",
+            "upstream replicas currently known to the pool (in rotation, "
+            "quarantined, or draining)",
+        ),
+        "joins": registry.counter(
+            "kdlt_pool_joins_total",
+            "replicas added to the pool by dynamic membership (resolver "
+            "or set_membership)",
+        ),
+        "leaves": registry.counter(
+            "kdlt_pool_leaves_total",
+            "replicas removed from the pool by dynamic membership",
+        ),
+    }
+
+
+def pool_replica_metrics(registry: "Registry", host: str) -> dict:
+    """One replica's pool series, minted under a single labeled child so
+    dynamic membership can retire ALL of a departed replica's series
+    atomically (``registry.remove(child)``) without leaving stale samples
+    on /metrics.  ``child`` is that handle; callers never mint through it
+    directly."""
+    child = registry.with_labels(replica=host)
+    return {
+        "child": child,
+        "healthy": child.gauge(
+            "kdlt_upstream_replica_healthy",
+            "1 while the upstream replica is considered healthy",
+        ),
+        "picks": child.counter(
+            "kdlt_pool_pick_total",
+            "times power-of-two-choices selection routed a primary "
+            "attempt to this replica",
+        ),
+        "ewma_ms": child.gauge(
+            "kdlt_pool_replica_ewma_ms",
+            "EWMA of this replica's observed request latency (the "
+            "power-of-two-choices ranking signal)",
+        ),
+    }
+
+
+def engine_warm_source_metrics(registry: "Registry") -> dict:
+    """Per-engine warmup provenance: how many buckets of the ladder came
+    up as persistent-compile-cache hits vs live XLA compiles.  The
+    ``source`` label's value set is exactly these two (bounded by
+    construction); a scaled-up pod whose AOT-warmed image is working
+    reports ``compile`` == 0, which is the zero-cold-start proof the
+    churn bench and the GUIDE §10k recipe key on."""
+    return {
+        source: registry.with_labels(source=source).counter(
+            "kdlt_engine_warm_source", help
+        )
+        for source, help in (
+            ("cache", "warmup buckets satisfied from the persistent "
+                      "compile cache (fast path)"),
+            ("compile", "warmup buckets that paid a live XLA compile"),
+        )
+    }
 
 
 def dispatch_stall_counter(registry: "Registry") -> "Counter":

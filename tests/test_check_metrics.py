@@ -166,6 +166,42 @@ def test_lint_flags_quant_series_minted_outside_central_module():
     ) == []
 
 
+def test_lint_flags_pool_series_minted_outside_central_module():
+    # Dynamic-membership series (ISSUE 11): kdlt_pool_* mints are confined
+    # to utils/metrics.py exactly like kdlt_slo_*/kdlt_cache_*.
+    src = 'reg.counter("kdlt_pool_joins_total", "rogue mint")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "kdlt_pool_" in v and "central" in v
+    assert check_metrics.lint_source(src, _METRICS_PATH) == []
+    src = 'reg.gauge("kdlt_pool_members", "rogue mint")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "central" in v
+
+
+def test_lint_flags_warm_source_series_minted_outside_central_module():
+    # kdlt_engine_warm_source carries the bounded ``source`` label but
+    # lives under the (uncentralizable) kdlt_engine_ prefix, so it is
+    # confined by exact name.
+    src = 'reg.counter("kdlt_engine_warm_source", "rogue mint")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "kdlt_engine_warm_source" in v and "central" in v
+    assert check_metrics.lint_source(src, _METRICS_PATH) == []
+    # Sibling kdlt_engine_* names stay mintable where the engine lives.
+    assert check_metrics.lint_source(
+        'reg.gauge("kdlt_engine_warmup_seconds", "ok")\n', "fake.py"
+    ) == []
+
+
+def test_lint_flags_source_label_outside_central():
+    (v,) = check_metrics.lint_source(
+        'reg.with_labels(source="cache")\n', "fake.py"
+    )
+    assert "source" in v and "central" in v
+    assert check_metrics.lint_source(
+        'reg.with_labels(source="cache")\n', _METRICS_PATH
+    ) == []
+
+
 def test_lint_flags_scheme_label_outside_central():
     src = 'reg.with_labels(scheme="int8-w8a8")\n'
     (v,) = check_metrics.lint_source(src, "fake.py")

@@ -160,15 +160,18 @@ def test_k8s_and_compose_drain_semantics():
 
 def test_k8s_model_tier_replicated_for_failover():
     """The serving-path fault-tolerance wiring (serving/upstream.py): the
-    model tier runs >= 2 replicas with stable per-pod DNS (StatefulSet +
-    headless Service), the gateway's KDLT_SERVING_HOST names each replica
-    individually, and the hedge/probe knobs are set."""
+    model tier runs >= 2 replicas behind a headless Service, the gateway
+    discovers them by re-resolving that Service's DNS name live
+    (KDLT_POOL_RESOLVE_S dynamic membership -- an HPA scale-up changes the
+    upstream pool with NO gateway redeploy), and the hedge/probe knobs
+    are set."""
     from kubernetes_deep_learning_tpu.serving.gateway import SERVING_HOST_ENV
     from kubernetes_deep_learning_tpu.serving.model_server import (
         DEFAULT_PORT as MODEL_PORT,
     )
     from kubernetes_deep_learning_tpu.serving.upstream import (
         HEDGE_DELAY_ENV,
+        POOL_RESOLVE_ENV,
         PROBE_INTERVAL_ENV,
         parse_hosts,
     )
@@ -181,7 +184,9 @@ def test_k8s_model_tier_replicated_for_failover():
     assert model_dep["spec"]["replicas"] >= 2, (
         "failover needs a survivor: the model tier must run >= 2 replicas"
     )
-    # Stable per-replica DNS requires a StatefulSet behind a headless Service.
+    # Stable per-replica DNS requires a StatefulSet behind a headless Service
+    # -- and headless is what makes the Service name resolve to EVERY ready
+    # pod address, which is what the gateway's re-resolver diffs.
     assert model_dep["kind"] == "StatefulSet"
     assert model_dep["spec"]["serviceName"] == model_svc["metadata"]["name"]
     assert model_svc["spec"].get("clusterIP") is None or (
@@ -191,14 +196,19 @@ def test_k8s_model_tier_replicated_for_failover():
     gw_container = gw_dep["spec"]["template"]["spec"]["containers"][0]
     env = {e["name"]: e.get("value", "") for e in gw_container.get("env", [])}
     hosts = parse_hosts(env[SERVING_HOST_ENV])
-    assert len(hosts) >= model_dep["spec"]["replicas"], (
-        f"{SERVING_HOST_ENV} must list every model-tier replica, got {hosts}"
+    svc_name = model_svc["metadata"]["name"]
+    # Dynamic membership: the gateway names the headless Service itself
+    # (one name resolving to the whole fleet), not a static per-pod list
+    # that every scale event would have to edit.
+    assert len(hosts) == 1, (
+        f"{SERVING_HOST_ENV} should name the headless Service once and let "
+        f"re-resolution track the fleet, got {hosts}"
     )
-    set_name = model_dep["metadata"]["name"]
-    for i, host in enumerate(hosts):
-        # StatefulSet pod DNS: <name>-<ordinal>.<headless-svc>...:<port>
-        assert host.startswith(f"{set_name}-{i}."), host
-        assert host.endswith(str(MODEL_PORT)), host
+    assert hosts[0].startswith(f"{svc_name}."), hosts[0]
+    assert hosts[0].endswith(str(MODEL_PORT)), hosts[0]
+    assert float(env[POOL_RESOLVE_ENV]) > 0, (
+        "dynamic membership wired off: a scale-up would never join the pool"
+    )
     assert float(env[HEDGE_DELAY_ENV]) > 0, "hedging must be wired on"
     assert float(env[PROBE_INTERVAL_ENV]) > 0, "active probing must be on"
 
@@ -554,6 +564,14 @@ def test_model_server_hpa_scales_on_minted_serving_signals():
     assert "kdlt_sched_floor_boosts_total" in names, (
         "the HPA must consume the scheduler's starvation-floor signal"
     )
+    assert "kdlt_admission_shed_total" in names, (
+        "the HPA must consume the admission shed rate (the leading "
+        "overload edge -- sheds fire before the burn windows move)"
+    )
+    assert "kdlt_sched_queue_depth" in names, (
+        "the HPA must consume the scheduler queue depth (a standing "
+        "queue is the knee before deadline misses)"
+    )
     for name in names:
         assert f'"{name}"' in metrics_src, (
             f"HPA scales on {name!r}, which utils/metrics.py does not mint "
@@ -568,6 +586,84 @@ def test_model_server_hpa_scales_on_minted_serving_signals():
     ]
     window = burn["selector"]["matchLabels"]["window"]
     assert window in [label for label, _ in slo_lib.WINDOWS]
+
+
+def test_gateway_hpa_scales_on_minted_shed_signal():
+    """The gateway HPA must scale on the admission shed rate -- a signal
+    the gateway itself mints -- not CPU (a gateway stalled on slow
+    upstreams sheds while its CPU idles); and every metric it names must
+    be a literal series name in utils/metrics.py."""
+    k8s = os.path.join(DEPLOY, "k8s")
+    docs = _yaml_docs(os.path.join(k8s, "gateway-hpa.yaml"))
+    (hpa,) = [d for d in docs if d["kind"] == "HorizontalPodAutoscaler"]
+    assert hpa["spec"]["scaleTargetRef"]["name"] == "serving-gateway"
+
+    metrics = hpa["spec"]["metrics"]
+    assert not any(m["type"] == "Resource" for m in metrics), (
+        "CPU-based scaling must be gone: shed rate is the load signal"
+    )
+    names = [
+        m["pods"]["metric"]["name"] for m in metrics if m["type"] == "Pods"
+    ]
+    assert "kdlt_admission_shed_total" in names
+    metrics_src = _read(os.path.join(
+        REPO, "kubernetes_deep_learning_tpu", "utils", "metrics.py"
+    ))
+    for name in names:
+        assert f'"{name}"' in metrics_src, (
+            f"HPA scales on {name!r}, which utils/metrics.py does not mint"
+        )
+
+
+def test_elastic_fleet_envs_agree_across_k8s_and_compose():
+    """Elastic-fleet wiring (ISSUE 11): the gateway's dynamic-membership
+    resolve interval and the model tier's AOT-warm boot flag are present
+    in BOTH deploy targets with values the code accepts, and the two
+    topologies agree -- a compose stack rehearsing a k8s rollout must
+    exhibit the same membership churn and warm-boot behavior."""
+    from kubernetes_deep_learning_tpu.serving.model_server import AOT_WARM_ENV
+    from kubernetes_deep_learning_tpu.serving.upstream import POOL_RESOLVE_ENV
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    services = compose["services"]
+
+    def k8s_env(dep):
+        (container,) = dep["spec"]["template"]["spec"]["containers"]
+        return {e["name"]: str(e.get("value", "")) for e in container["env"]}
+
+    resolve = {
+        "k8s/gateway": k8s_env(gw_dep).get(POOL_RESOLVE_ENV),
+        "compose/gateway": str(
+            services["gateway"]["environment"].get(POOL_RESOLVE_ENV)
+        ),
+    }
+    assert all(v not in (None, "None") for v in resolve.values()), resolve
+    assert len(set(resolve.values())) == 1, (
+        f"{POOL_RESOLVE_ENV} disagrees across gateways: {resolve}"
+    )
+    assert float(next(iter(resolve.values()))) > 0
+
+    warm = {"k8s/model-server": k8s_env(model_dep).get(AOT_WARM_ENV)}
+    for svc in ("model-server", "model-server-b"):
+        warm[f"compose/{svc}"] = str(
+            services[svc]["environment"].get(AOT_WARM_ENV)
+        )
+    assert all(v not in (None, "None") for v in warm.values()), warm
+    assert len(set(warm.values())) == 1, (
+        f"{AOT_WARM_ENV} disagrees across the model tiers: {warm}"
+    )
+    # The value must be one the server's truthy parse accepts.
+    assert next(iter(warm.values())).strip().lower() in ("1", "true", "yes")
+
+    # The image-build half of the warm story: the model-server dockerfile
+    # bakes the cache with the kdlt-warm console script.
+    dockerfile = _read(os.path.join(DEPLOY, "model-server.dockerfile"))
+    assert "kdlt-warm" in dockerfile, (
+        "model-server.dockerfile must bake the compile cache (kdlt-warm)"
+    )
 
 
 def test_slo_target_agrees_across_every_tier_and_topology():

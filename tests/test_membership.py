@@ -1,0 +1,484 @@
+"""Dynamic replica membership (serving/upstream.py, ISSUE 11): joiners
+quarantined until their first /readyz 200, leavers drained without dropping
+in-flight work, DNS-flap spec-memo restore, power-of-two-choices selection,
+prober lifecycle (no leaked threads or stale per-replica series), and the
+drain-ordering contract (readiness flips BEFORE in-flight completion).
+All device-free."""
+
+from __future__ import annotations
+
+import http.server
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+from kubernetes_deep_learning_tpu.serving.upstream import UpstreamPool
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+
+def _metric(text: str, name: str, **labels: str) -> float:
+    for m in re.finditer(rf"^{re.escape(name)}(\{{[^}}]*\}})? (\S+)$", text, re.M):
+        got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1) or ""))
+        if all(got.get(k) == v for k, v in labels.items()):
+            return float(m.group(2))
+    raise AssertionError(f"no sample {name} with {labels} in:\n{text}")
+
+
+class _StatusServer:
+    """Minimal health endpoint whose /readyz and /healthz status codes the
+    test scripts directly -- a replica's health surface without a replica."""
+
+    def __init__(self):
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                code = outer.codes.get(self.path, 404)
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.codes = {"/readyz": 200, "/healthz": 200}
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self.host = f"127.0.0.1:{self._httpd.server_address[1]}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def status_server():
+    s = _StatusServer()
+    yield s
+    s.close()
+
+
+def _make_stub_server(name, tmp_path, subdir="models", device_ms=0.0, **kw):
+    spec = register_spec(
+        ModelSpec(
+            name=name,
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    root = tmp_path / subdir
+    art.save_artifact(
+        art.version_dir(str(root), spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        str(root), port=kw.pop("port", 0), buckets=kw.pop("buckets", (1, 2)),
+        max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=lambda a, **ekw: StubEngine(
+            a, device_ms_per_batch=device_ms, **ekw
+        ),
+        **kw,
+    )
+    server.warmup()
+    server.start()
+    return spec, server
+
+
+IMG = np.zeros((1, 32, 32, 3), np.uint8)
+
+
+# --- membership deltas -------------------------------------------------------
+
+
+def test_joiner_quarantined_until_first_readyz_200(status_server):
+    pool = UpstreamPool(["h1:1"], failover=True, probe_interval_s=0)
+    delta = pool.set_membership(["h1:1", status_server.host])
+    assert delta == {"joined": [status_server.host], "left": []}
+    joiner = pool.replicas[1]
+    assert joiner.quarantined and not joiner.routable
+    # Invisible to selection: every pick lands on the incumbent.
+    incumbent = pool.replicas[0]
+    assert all(pool.choose() is incumbent for _ in range(4))
+    # Not even reachable as last-resort fallback (unlike plain unhealthy).
+    assert pool.choose(exclude=[incumbent]) is None
+    # Warming pod: /readyz not yet 200 -> quarantine holds.
+    status_server.codes["/readyz"] = 503
+    pool.probe_once()
+    assert joiner.quarantined
+    # First /readyz 200 releases it into rotation.
+    status_server.codes["/readyz"] = 200
+    pool.probe_once()
+    assert not joiner.quarantined and joiner.routable
+    assert joiner in {pool.choose() for _ in range(4)}
+
+
+def test_blind_mode_joiners_skip_quarantine():
+    # KDLT_FAILOVER=0 has no prober to release a quarantine; joiners go
+    # straight into the blind rotation.
+    pool = UpstreamPool(["h1:1"], failover=False, probe_interval_s=0)
+    pool.set_membership(["h1:1", "h2:2"])
+    assert not pool.replicas[1].quarantined
+    assert {pool.choose() for _ in range(4)} == set(pool.replicas)
+
+
+def test_empty_view_refused_and_noop_delta():
+    pool = UpstreamPool(["h1:1", "h2:2"], failover=True, probe_interval_s=0)
+    # A DNS outage resolving to nothing must not dump the fleet.
+    assert pool.set_membership([]) == {"joined": [], "left": []}
+    assert [r.host for r in pool.replicas] == ["h1:1", "h2:2"]
+    # Same view again: no churn counted.
+    pool.set_membership(["h2:2", "h1:1"])
+    assert pool.joins == 0 and pool.leaves == 0
+
+
+def test_leave_keeps_incumbent_state_and_retires_series():
+    registry = metrics_lib.Registry()
+    pool = UpstreamPool(
+        ["h1:1", "h2:2"], registry=registry, failover=True, probe_interval_s=0
+    )
+    keeper = pool.replicas[0]
+    keeper.note_latency(0.05)  # state that must survive the delta
+    delta = pool.set_membership(["h1:1"])
+    assert delta == {"joined": [], "left": ["h2:2"]}
+    assert pool.replicas == [keeper]
+    assert keeper.ewma_ms == pytest.approx(50.0)
+    text = registry.render()
+    assert _metric(text, "kdlt_pool_members") == 1.0
+    assert _metric(text, "kdlt_pool_leaves_total") == 1.0
+    # The departed replica's per-replica series are retired, not left
+    # stale on /metrics.
+    assert 'replica="h2:2"' not in text
+    assert _metric(text, "kdlt_pool_pick_total", replica="h1:1") >= 0.0
+
+
+def test_dns_flap_restores_memoized_spec(status_server):
+    pool = UpstreamPool(
+        ["h1:1", status_server.host], failover=True, probe_interval_s=0
+    )
+    flapper = pool.replicas[1]
+    sentinel, extra = object(), object()
+    flapper.spec = sentinel
+    flapper.specs = {"other-model": extra}
+    # The endpoint drops out of DNS...
+    pool.set_membership(["h1:1"])
+    assert len(pool.replicas) == 1
+    # ...and flaps back: re-added quarantined, spec not yet restored.
+    pool.set_membership(["h1:1", status_server.host])
+    readded = pool.replicas[1]
+    assert readded is not flapper and readded.quarantined
+    assert readded.spec is None
+    # Quarantine release restores the memoized contracts instead of
+    # re-paying discovery (per-request validation still guards staleness).
+    pool.probe_once()
+    assert not readded.quarantined
+    assert readded.spec is sentinel
+    assert readded.specs == {"other-model": extra}
+    # The memo is consumed: a later rejoin re-discovers.
+    assert status_server.host not in pool._spec_memo
+
+
+def test_spec_memo_is_bounded():
+    from kubernetes_deep_learning_tpu.serving.upstream import SPEC_MEMO_CAP
+
+    pool = UpstreamPool(["h1:1"], failover=True, probe_interval_s=0)
+    for i in range(SPEC_MEMO_CAP + 10):
+        host = f"flap{i}:9"
+        pool.set_membership(["h1:1", host])
+        pool.replicas[1].spec = object()
+        pool.set_membership(["h1:1"])
+    assert len(pool._spec_memo) == SPEC_MEMO_CAP
+    assert "flap0:9" not in pool._spec_memo  # oldest fell off first
+
+
+def test_resolve_now_applies_injected_resolver_delta():
+    view = ["h1:1", "h2:2"]
+    pool = UpstreamPool(
+        ["h1:1", "h2:2"], failover=True, probe_interval_s=0,
+        resolver=lambda: list(view), resolve_interval_s=0,
+    )
+    # An explicit resolver implies dynamic membership even without
+    # KDLT_POOL_RESOLVE_S: the default cadence applies.
+    assert pool.resolve_interval_s > 0
+    view.append("h3:3")
+    assert pool.resolve_now() == {"joined": ["h3:3"], "left": []}
+    view.remove("h1:1")
+    assert pool.resolve_now() == {"joined": [], "left": ["h1:1"]}
+    assert [r.host for r in pool.replicas] == ["h2:2", "h3:3"]
+    # A resolver blip (exception) is treated as an empty view: refused.
+    def boom():
+        raise OSError("dns down")
+
+    pool.resolver = boom
+    assert pool.resolve_now() == {"joined": [], "left": []}
+    assert len(pool.replicas) == 2
+
+
+# --- power-of-two-choices selection ------------------------------------------
+
+
+def test_p2c_prefers_lighter_ewma_replica():
+    pool = UpstreamPool(["h1:1", "h2:2"], failover=True, probe_interval_s=0)
+    heavy, light = pool.replicas
+    for _ in range(5):
+        heavy.note_latency(0.100)
+        light.note_latency(0.010)
+    # Both routable, rigged EWMAs: the lighter one wins EVERY pick (in a
+    # two-replica pool both are always the two choices).
+    assert all(pool.choose() is light for _ in range(6))
+    # The signal is live: the light replica slowing past the heavy one
+    # flips the preference within a few samples.
+    for _ in range(20):
+        light.note_latency(0.500)
+    assert pool.choose() is heavy
+
+
+def test_p2c_unsampled_replica_ranks_lightest():
+    # A joiner with no latency samples must RECEIVE traffic to earn them;
+    # ranking it heaviest would starve it forever.
+    pool = UpstreamPool(["h1:1", "h2:2"], failover=True, probe_interval_s=0)
+    sampled, fresh = pool.replicas
+    sampled.note_latency(0.005)  # even a FAST sampled replica
+    assert all(pool.choose() is fresh for _ in range(4))
+
+
+def test_p2c_no_signal_degrades_to_round_robin():
+    # The PR 3 contract test_pool_round_robins_and_prefers_healthy relies
+    # on: a signal-less pool is exactly the old rotation.
+    pool = UpstreamPool(["h1:1", "h2:2"], failover=True, probe_interval_s=0)
+    a, b = pool.replicas
+    assert [pool.choose() for _ in range(4)] == [a, b, a, b]
+
+
+# --- prober lifecycle --------------------------------------------------------
+
+
+def _prober_threads():
+    return [
+        t for t in threading.enumerate() if t.name == "kdlt-upstream-prober"
+    ]
+
+
+def test_close_stops_prober_thread_and_is_restartable():
+    before = len(_prober_threads())
+    pool = UpstreamPool(
+        ["h1:1", "h2:2"], failover=True, probe_interval_s=0.05
+    )
+    pool.start_probing()
+    pool.start_probing()  # idempotent: still one thread
+    assert len(_prober_threads()) == before + 1
+    pool.close()
+    assert pool._probe_thread is None
+    deadline = time.monotonic() + 2.0
+    while len(_prober_threads()) > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(_prober_threads()) == before, "close() leaked the prober"
+    # Restartable: a stopped pool can start probing again (gateway restart
+    # paths construct-once, start/stop many).
+    pool.start_probing()
+    assert len(_prober_threads()) == before + 1
+    pool.close()
+
+
+def test_single_replica_pool_with_resolver_still_probes():
+    # One replica alone needs no prober -- unless dynamic membership could
+    # add a second at any tick.
+    static = UpstreamPool(["h1:1"], failover=True, probe_interval_s=0.05)
+    static.start_probing()
+    assert static._probe_thread is None
+    dynamic = UpstreamPool(
+        ["h1:1"], failover=True, probe_interval_s=0.05,
+        resolver=lambda: ["h1:1"], resolve_interval_s=0.05,
+    )
+    dynamic.start_probing()
+    assert dynamic._probe_thread is not None
+    dynamic.close()
+
+
+def test_churn_does_not_leak_series_or_duplicate_on_flap():
+    registry = metrics_lib.Registry()
+    pool = UpstreamPool(
+        ["h1:1"], registry=registry, failover=True, probe_interval_s=0
+    )
+    for _ in range(5):  # the same endpoint flapping in and out
+        pool.set_membership(["h1:1", "flap:9"])
+        pool.set_membership(["h1:1"])
+    text = registry.render()
+    assert 'replica="flap:9"' not in text  # every leave retired its series
+    assert len(re.findall(r'kdlt_pool_pick_total\{[^}]*"h1:1"', text)) == 1
+    assert _metric(text, "kdlt_pool_joins_total") == 5.0
+    assert _metric(text, "kdlt_pool_leaves_total") == 5.0
+    assert _metric(text, "kdlt_pool_members") == 1.0
+
+
+# --- drain ordering + leave-under-load through the real tiers ----------------
+
+
+def test_drain_flips_readyz_before_inflight_completion(tmp_path):
+    """Satellite 2 (ISSUE 11): a SIGTERM'd model server leaves rotation
+    BEFORE its in-flight work completes -- /readyz flips at drain START and
+    the pool's drain watch pulls it from new-primary rotation while the
+    in-flight predict is still running, then that predict finishes 200."""
+    import requests
+
+    from kubernetes_deep_learning_tpu.serving import protocol
+
+    spec, server = _make_stub_server(
+        "drain-order", tmp_path, device_ms=700.0
+    )
+    base = f"http://127.0.0.1:{server.port}"
+    pool = UpstreamPool(
+        [f"127.0.0.1:{server.port}"], failover=True, probe_interval_s=0.05
+    )
+    replica = pool.replicas[0]
+    result: dict = {}
+
+    def slow_predict():
+        result["resp"] = requests.post(
+            f"{base}/v1/models/{spec.name}:predict",
+            data=protocol.encode_predict_request(IMG),
+            headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+            timeout=30.0,
+        )
+
+    t = threading.Thread(target=slow_predict)
+    try:
+        t.start()
+        time.sleep(0.15)  # the predict is on the device (700ms stub)
+        server.begin_drain()  # the CLI's SIGTERM path
+        # ORDERING: readiness flips while the request is still in flight...
+        assert requests.get(f"{base}/readyz", timeout=5).status_code != 200
+        assert t.is_alive(), "in-flight predict finished before the check"
+        # ...the drain watch sees it and pulls the replica from rotation...
+        pool.probe_once()
+        assert replica.draining and not replica.routable
+        assert pool.choose() is None  # no new primaries into a drain
+        # ...and the in-flight request still completes successfully.
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert result["resp"].status_code == 200
+        # Liveness stays 200 through the drain: k8s must not kill a
+        # draining pod early.
+        assert requests.get(f"{base}/healthz", timeout=5).status_code == 200
+    finally:
+        t.join(timeout=10.0)
+        pool.close()
+        server.shutdown()
+
+
+def test_leave_under_load_drops_nothing(tmp_path):
+    """A replica removed from membership mid-request: the in-flight work
+    dispatched to it completes 200 (nothing cancelled), new picks go to
+    the survivor only, and the leaver's accounting is retired."""
+    spec, leaver = _make_stub_server(
+        "leave-load", tmp_path, subdir="a", device_ms=500.0
+    )
+    _, survivor = _make_stub_server("leave-load", tmp_path, subdir="b")
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{leaver.port},127.0.0.1:{survivor.port}",
+        model=spec.name, port=0, bind=False, probe_interval_s=0,
+    )
+    result: dict = {}
+    try:
+        gw.spec
+        gw.pool._rr = 0  # the in-flight request lands on the leaver
+
+        def inflight():
+            result["out"] = gw._predict_batch(IMG)
+
+        t = threading.Thread(target=inflight)
+        t.start()
+        time.sleep(0.1)  # dispatched to the leaver (500ms stub)
+        delta = gw.pool.set_membership([f"127.0.0.1:{survivor.port}"])
+        assert delta["left"] == [f"127.0.0.1:{leaver.port}"]
+        # New picks see only the survivor...
+        only = gw.pool.replicas
+        assert [r.host for r in only] == [f"127.0.0.1:{survivor.port}"]
+        logits, _ = gw._predict_batch(IMG)
+        assert np.asarray(logits).shape == (1, 3)
+        # ...while the request already in flight on the leaver completes.
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        logits, labels = result["out"]
+        assert list(labels) == ["a", "b", "c"]
+        text = gw.registry.render()
+        assert _metric(text, "kdlt_pool_leaves_total") == 1.0
+        assert f'replica="127.0.0.1:{leaver.port}"' not in text
+    finally:
+        gw.shutdown()
+        leaver.shutdown()
+        survivor.shutdown()
+
+
+def test_gateway_debug_pool_reports_membership_and_picks(tmp_path):
+    import json
+    import urllib.request
+
+    spec, server = _make_stub_server("dbg-pool", tmp_path)
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, probe_interval_s=0,
+    )
+    try:
+        gw.start()
+        gw.spec
+        gw._predict_batch(IMG)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gw.port}/debug/pool", timeout=5
+        ) as r:
+            payload = json.loads(r.read())
+        assert payload["members"] == 1
+        assert payload["failover"] is True
+        row = payload["replicas"][0]
+        assert row["host"] == f"127.0.0.1:{server.port}"
+        assert row["healthy"] is True and row["picks"] >= 1
+        assert row["ewma_ms"] is None or row["ewma_ms"] > 0
+        # The kdlt-client --stats rendering consumes exactly this payload.
+        from kubernetes_deep_learning_tpu.serving.client import render_pool
+
+        text = render_pool(payload)
+        assert f"127.0.0.1:{server.port}" in text
+        assert "up" in text and "picks" in text
+    finally:
+        gw.shutdown()
+        server.shutdown()
+
+
+def test_drain_watch_undrains_on_readyz_recovery(status_server):
+    # A rollout aborted: /readyz flips 503 then back to 200 -- the replica
+    # must re-enter rotation without a health (healthz) rejoin cycle.
+    pool = UpstreamPool(
+        [status_server.host], failover=True, probe_interval_s=0.05
+    )
+    r = pool.replicas[0]
+    status_server.codes["/readyz"] = 503
+    pool.probe_once()
+    assert r.draining and not r.routable
+    status_server.codes["/readyz"] = 200
+    pool.probe_once()
+    assert not r.draining and r.routable
+
+
+def test_dead_while_draining_demotes_to_unhealthy(status_server):
+    pool = UpstreamPool(
+        [status_server.host], failover=True, probe_interval_s=0.05
+    )
+    r = pool.replicas[0]
+    status_server.codes["/readyz"] = 503
+    pool.probe_once()
+    assert r.draining
+    # The draining process dies: recovery is handed to the /healthz path
+    # (draining is a live-process state; a dead one is just unhealthy).
+    status_server.close()
+    pool.probe_once()
+    assert not r.draining and not r.healthy

@@ -27,7 +27,8 @@ Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
   bucket) no matter what names a caller feeds in.  The same rule covers
   the other bounded labels: ``window`` (the SLO engine's fixed window set),
   ``class`` (the tracer's retention classes), ``reason`` (cache eviction
-  reasons), and ``scheme`` (the quantization scheme list);
+  reasons), ``scheme`` (the quantization scheme list), and ``source`` (the
+  warmup provenance pair);
 - ``kdlt_slo_*`` series must be minted inside utils/metrics.py: the SLO
   engine's gauge matrix is (bounded model) x (fixed window), and a module
   minting its own slice would bypass both bounds at once;
@@ -51,14 +52,19 @@ METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # Labels whose value sets are bounded by construction inside utils/metrics.py
 # (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
 # the trace retention classes; reason: the cache eviction reasons; scheme:
-# the quantization scheme list) -- attaching them anywhere else escapes the
-# bound.
-CENTRAL_LABELS = {"model", "window", "class", "reason", "scheme"}
+# the quantization scheme list; source: the warmup provenance pair) --
+# attaching them anywhere else escapes the bound.
+CENTRAL_LABELS = {"model", "window", "class", "reason", "scheme", "source"}
 # Series prefixes whose minting is confined to utils/metrics.py even beyond
 # the general helper conventions (the SLO gauge matrix, the response
-# cache's series, and the quantization scheme/gate series: all carry
-# bounded labels a stray mint would escape).
-CENTRAL_PREFIXES = ("kdlt_slo_", "kdlt_cache_", "kdlt_quant_")
+# cache's series, the quantization scheme/gate series, and the dynamic-
+# membership pool series: all carry bounded labels a stray mint would
+# escape).
+CENTRAL_PREFIXES = ("kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_")
+# Exact series names likewise confined to utils/metrics.py: these live
+# under prefixes too broad to confine wholesale (kdlt_engine_* is minted
+# per-engine in runtime/engine.py) but carry a bounded label.
+CENTRAL_NAMES = ("kdlt_engine_warm_source",)
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
 SKIP_PARTS = {"tfs_gen", "__pycache__"}
 
@@ -189,14 +195,16 @@ def lint_source(src: str, rel: str) -> list[str]:
                     f"{rel}:{node.lineno}: metric name {head!r} is not "
                     f"{METRIC_PREFIX}-prefixed"
                 )
-            elif not is_metrics_module and any(
-                head.startswith(p) for p in CENTRAL_PREFIXES
+            elif not is_metrics_module and (
+                any(head.startswith(p) for p in CENTRAL_PREFIXES)
+                or head in CENTRAL_NAMES
             ):
                 violations.append(
                     f"{rel}:{node.lineno}: {head!r} minted outside "
-                    "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_* "
-                    "series are minted only by the central helpers (bounded "
-                    "label sets by construction)"
+                    "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
+                    "kdlt_pool_* series (and kdlt_engine_warm_source) are "
+                    "minted only by the central helpers (bounded label sets "
+                    "by construction)"
                 )
     return violations
 
