@@ -1759,6 +1759,403 @@ def bench_multimodel_ab(duration_s=6.0, heavy_device_ms=120.0,
     return out, 0 if ok else 1
 
 
+def bench_tenant_ab(duration_s=5.0, device_ms=50.0, deadline_ms=1500.0,
+                    rate_x=3.0, b_rps=12.0, buckets=(1, 2), flood_s=6.0,
+                    tail_s=12.0, interactive_rps=10.0, batch_rps=5.0,
+                    besteffort_rps=100.0, brownout_deadline_ms=1000.0,
+                    seed=0):
+    """Tenant isolation + brownout acceptance: budgets A/B, then the ladder.
+
+    Two proofs in one harness (serving/admission, GUIDE 10l):
+
+    PART 1 -- per-model admission budgets.  ONE real ModelServer serves two
+    stub-backed models ("tenant-a", "tenant-b") from one registry; tenant A
+    is offered ``rate_x`` times the tier's whole capacity while tenant B
+    asks for a modest, comfortably-servable ``b_rps``.  Run twice: budgets
+    ON (KDLT_ADMIT_BUDGETS=tenant-a=1,tenant-b=1) vs the legacy SHARED
+    limiter (KDLT_ADMIT_BUDGETS=0); everything else -- scheduler weights
+    included -- is identical, so the delta is attributable to admission
+    partitioning alone.  Under the shared limiter A's flood owns the
+    admission queue (B's arrivals find it full of equal-priority earlier
+    waiters and shed queue_full); with budgets B's under-share arrivals
+    evict A's over-share waiters and grant first.  Gate: tenant B holds
+    >= 95% in-deadline goodput with budgets while the shared baseline
+    collapses below 0.8x of that.
+
+    PART 2 -- SLO-burn brownout.  A real Gateway (cache on, short injected
+    "5m" SLO window, fast brownout dwell) fronts one stub model tier.
+    Interactive clients fetch a small cacheable URL universe for the whole
+    run; a best-effort flood of always-distinct URLs overloads the model
+    tier mid-run.  The tier's sheds blow the 5m burn past the enter
+    thresholds, the ladder climbs to stage >= 3, best-effort is shed 429
+    at the gateway front door (excluded from the burn denominator -- the
+    recovery mechanism), the window rolls the bad epoch off, and the
+    ladder walks back down.  Gates: interactive in-deadline goodput >= 95%
+    across the WHOLE run (flood included), final 5m burn < 1.0, peak stage
+    >= 3, and zero stage flaps (the transition log is monotone: never an
+    up-transition after a down-transition).
+
+    Returns (json_dict, rc); rc=0 iff all gates above hold.
+    """
+    import tempfile
+    import threading
+    from contextlib import contextmanager
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    import requests
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    @contextmanager
+    def scoped_env(overrides: dict):
+        old = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            yield
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    shape = (32, 32, 3)
+    specs = {
+        name: register_spec(ModelSpec(
+            name=name, family="xception",  # never instantiated by StubEngine
+            input_shape=shape, labels=("a", "b", "c"),
+        ))
+        for name in ("tenant-a", "tenant-b")
+    }
+    buckets = tuple(sorted(buckets))
+    capacity_rps = buckets[-1] / (device_ms / 1e3)
+    a_rps = rate_x * capacity_rps
+    deadline_s = deadline_ms / 1e3
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 256, size=(1, *shape), dtype=np.uint8)
+    body = protocol.encode_predict_request(img)
+    log(
+        f"tenant A/B part 1: capacity {capacity_rps:.0f} img/s "
+        f"({buckets[-1]}-bucket / {device_ms}ms); tenant-a {a_rps:.0f} rps "
+        f"({rate_x:g}x), tenant-b {b_rps:g} rps, deadline "
+        f"{deadline_ms:.0f}ms, {duration_s}s per arm"
+    )
+
+    def run_budget_arm(budgets_on: bool) -> dict:
+        env = {
+            "KDLT_ADMIT_BUDGETS": (
+                "tenant-a=1,tenant-b=1" if budgets_on else "0"
+            ),
+            # Identical in both arms: a tight admission ceiling (the flood
+            # must contend for slots, not hide behind a huge limit) and
+            # fair DEVICE-time weights, so admission partitioning is the
+            # only delta under test.
+            "KDLT_ADMISSION_MAX_CONCURRENCY": "8",
+            "KDLT_ADMISSION_INITIAL_CONCURRENCY": "8",
+            "KDLT_SCHED_WEIGHTS": "tenant-a=1,tenant-b=1",
+        }
+        with scoped_env(env):
+            root = tempfile.mkdtemp(prefix="kdlt-tenant-")
+            for spec in specs.values():
+                art.save_artifact(
+                    art.version_dir(root, spec.name, 1), spec,
+                    {"params": {}}, None, {},
+                )
+            server = ModelServer(
+                root, port=0, buckets=buckets, max_delay_ms=1.0,
+                host="127.0.0.1",
+                engine_factory=lambda a, **kw: StubEngine(
+                    a, device_ms_per_batch=device_ms, **kw
+                ),
+            )
+            server.warmup()
+            server.start()
+        session = requests.Session()
+        session.mount("http://", requests.adapters.HTTPAdapter(
+            pool_connections=4, pool_maxsize=1024,
+        ))
+        headers = {
+            "Content-Type": protocol.MSGPACK_CONTENT_TYPE,
+            DEADLINE_HEADER: f"{deadline_ms:.1f}",
+        }
+        plans = {"tenant-a": a_rps, "tenant-b": b_rps}
+        results: dict[str, list] = {name: [] for name in plans}
+        results_lock = threading.Lock()
+
+        def fire(name: str, at: float) -> None:
+            delay = at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                r = session.post(
+                    f"http://127.0.0.1:{server.port}/v1/models/{name}:predict",
+                    data=body, headers=headers, timeout=30.0,
+                )
+                status = r.status_code
+            except Exception:
+                status = -1
+            lat = time.monotonic() - at  # open-loop: from the SCHEDULED send
+            with results_lock:
+                results[name].append((lat, status))
+
+        t_base = time.monotonic() + 0.25
+        threads = []
+        for name, rps in plans.items():
+            for i in range(int(duration_s * rps)):
+                threads.append(threading.Thread(
+                    target=fire, args=(name, t_base + i / rps), daemon=True,
+                ))
+        for t in threads:
+            t.start()
+        end_by = t_base + duration_s + max(2.0, 3 * deadline_s)
+        for t in threads:
+            t.join(timeout=max(0.0, end_by - time.monotonic()))
+        # Budget shares snapshot (in-process: the bench owns the server);
+        # reported, never gating.
+        limiter = server.admission.limiter
+        shares = limiter.shares() if limiter is not None else None
+        server.shutdown()
+        for t in threads:
+            t.join(timeout=10.0)
+        arm: dict = {"budgets": budgets_on, "models": {}, "admission": shares}
+        for name, rps in plans.items():
+            offered = int(duration_s * rps)
+            done = results[name]
+            in_deadline = sum(
+                1 for lat, status in done
+                if status == 200 and lat <= deadline_s
+            )
+            arm["models"][name] = {
+                "offered": offered,
+                "resolved": len(done),
+                "completed_200": sum(1 for _, s in done if s == 200),
+                "shed": sum(1 for _, s in done if s in (429, 503, 504)),
+                "in_deadline": in_deadline,
+                "goodput_frac": round(in_deadline / max(offered, 1), 3),
+            }
+        log(
+            f"  budgets={'on ' if budgets_on else 'off'}: "
+            + " ".join(
+                f"{n} goodput {m['goodput_frac']:.3f} "
+                f"({m['in_deadline']}/{m['offered']}, {m['shed']} shed)"
+                for n, m in arm["models"].items()
+            )
+        )
+        return arm
+
+    arm_budgets = run_budget_arm(True)
+    arm_shared = run_budget_arm(False)
+    b_budget = arm_budgets["models"]["tenant-b"]["goodput_frac"]
+    b_shared = arm_shared["models"]["tenant-b"]["goodput_frac"]
+    part1_ok = b_budget >= 0.95 and b_shared < 0.8 * b_budget
+
+    # ---- PART 2: the brownout ladder over a real gateway + model tier ----
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    brown_deadline_s = brownout_deadline_ms / 1e3
+    total_s = flood_s + tail_s
+    window_s = 6.0
+    dwell_s = 1.0
+    img_dir = tempfile.mkdtemp(prefix="kdlt-tenant-img-")
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(img_dir, "img.png"))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    base_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+    log(
+        f"tenant A/B part 2: brownout ladder -- interactive "
+        f"{interactive_rps:g} rps + batch {batch_rps:g} rps for {total_s:g}s,"
+        f" best-effort flood {besteffort_rps:g} rps for {flood_s:g}s; "
+        f"'5m' window {window_s:g}s, dwell {dwell_s:g}s, deadline "
+        f"{brownout_deadline_ms:.0f}ms"
+    )
+
+    root = tempfile.mkdtemp(prefix="kdlt-tenant-gw-")
+    art.save_artifact(
+        art.version_dir(root, "tenant-a", 1), specs["tenant-a"],
+        {"params": {}}, None, {},
+    )
+    server = ModelServer(
+        root, port=0, buckets=buckets, max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=lambda a, **kw: StubEngine(
+            a, device_ms_per_batch=device_ms, **kw
+        ),
+    )
+    server.warmup()
+    server.start()
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model="tenant-a",
+        port=0, host="127.0.0.1", cache=True, cache_swr_s=30.0,
+        slo_windows=(("5m", window_s),),
+        brownout_dwell_s=dwell_s, brownout_eval_s=0.2,
+    )
+    gw.start()
+    gw.spec  # discover the contract before the clock starts
+
+    session = requests.Session()
+    session.mount("http://", requests.adapters.HTTPAdapter(
+        pool_connections=4, pool_maxsize=1024,
+    ))
+    class_results: dict[str, list] = {
+        "interactive": [], "batch": [], "best-effort": [],
+    }
+    class_lock = threading.Lock()
+
+    def fire_gw(cls: str, url_tag: str, at: float) -> None:
+        delay = at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            r = session.post(
+                f"http://127.0.0.1:{gw.port}/predict",
+                json={"url": f"{base_url}?{url_tag}"},
+                headers={
+                    DEADLINE_HEADER: f"{brownout_deadline_ms:.1f}",
+                    protocol.PRIORITY_HEADER: cls,
+                },
+                timeout=brown_deadline_s + 5.0,
+            )
+            status = r.status_code
+        except Exception:
+            status = -1
+        lat = time.monotonic() - at
+        with class_lock:
+            class_results[cls].append((lat, status))
+
+    threads = []
+    t_base = time.monotonic() + 0.25
+    for i in range(int(total_s * interactive_rps)):
+        threads.append(threading.Thread(
+            target=fire_gw,
+            args=("interactive", f"i={i % 8}", t_base + i / interactive_rps),
+            daemon=True,
+        ))
+    for i in range(int(total_s * batch_rps)):
+        threads.append(threading.Thread(
+            target=fire_gw,
+            args=("batch", f"b={i % 4}", t_base + i / batch_rps),
+            daemon=True,
+        ))
+    flood_t0 = t_base + 1.0  # one clean second first: burn starts at 0
+    for i in range(int(flood_s * besteffort_rps)):
+        threads.append(threading.Thread(
+            target=fire_gw,
+            args=("best-effort", f"f={i}", flood_t0 + i / besteffort_rps),
+            daemon=True,
+        ))
+    for t in threads:
+        t.start()
+    end_by = t_base + total_s + max(2.0, 3 * brown_deadline_s)
+    for t in threads:
+        t.join(timeout=max(0.0, end_by - time.monotonic()))
+    brownout_view: dict = {}
+    cache_view: dict = {}
+    try:
+        brownout_view = session.get(
+            f"http://127.0.0.1:{gw.port}/debug/brownout", timeout=5.0
+        ).json()
+        cache_view = session.get(
+            f"http://127.0.0.1:{gw.port}/debug/cache", timeout=5.0
+        ).json()
+    except Exception:  # noqa: BLE001 - gates below then fail loudly
+        pass
+    gw.shutdown()
+    server.shutdown()
+    img_httpd.shutdown()
+    for t in threads:
+        t.join(timeout=10.0)
+
+    part2: dict = {"classes": {}}
+    for cls, rows in class_results.items():
+        offered = {
+            "interactive": int(total_s * interactive_rps),
+            "batch": int(total_s * batch_rps),
+            "best-effort": int(flood_s * besteffort_rps),
+        }[cls]
+        in_deadline = sum(
+            1 for lat, status in rows
+            if status == 200 and lat <= brown_deadline_s
+        )
+        part2["classes"][cls] = {
+            "offered": offered,
+            "resolved": len(rows),
+            "completed_200": sum(1 for _, s in rows if s == 200),
+            "shed_429": sum(1 for _, s in rows if s == 429),
+            "shed_5xx": sum(1 for _, s in rows if s in (503, 504)),
+            "in_deadline": in_deadline,
+            "goodput_frac": round(in_deadline / max(offered, 1), 3),
+        }
+    transitions = brownout_view.get("transitions") or []
+    stages = [int(tr.get("to", 0)) for tr in transitions]
+    peak_stage = max(stages, default=0)
+    seen_down = False
+    flap_free = True
+    for tr in transitions:
+        if int(tr.get("to", 0)) < int(tr.get("from", 0)):
+            seen_down = True
+        elif seen_down:
+            flap_free = False
+    burn_final = float(brownout_view.get("burn") or 0.0)
+    inter_frac = part2["classes"]["interactive"]["goodput_frac"]
+    part2.update({
+        "burn_final": round(burn_final, 3),
+        "peak_stage": peak_stage,
+        "final_stage": int(brownout_view.get("stage") or 0),
+        "transitions": transitions,
+        "flap_free": flap_free,
+        "stale_hits": cache_view.get("stale_hits", 0),
+        "brownout": {
+            k: brownout_view.get(k)
+            for k in ("enabled", "burn_enter", "burn_exit", "dwell_s")
+        },
+    })
+    part2_ok = (
+        inter_frac >= 0.95
+        and burn_final < 1.0
+        and peak_stage >= 3
+        and flap_free
+    )
+    log(
+        f"  brownout arm: interactive goodput {inter_frac:.3f}, peak stage "
+        f"{peak_stage}, final stage {part2['final_stage']}, final 5m burn "
+        f"{burn_final:.3f}, {len(transitions)} transitions "
+        f"({'monotone' if flap_free else 'FLAPPED'})"
+    )
+
+    ok = part1_ok and part2_ok
+    out = {
+        "metric": (
+            f"tenant isolation + brownout A/B (2 stub tenants, tenant-a at "
+            f"{rate_x:g}x capacity; budgets vs shared limiter; then a "
+            f"best-effort flood through the real gateway): victim tenant-b "
+            f"in-deadline goodput, and the brownout ladder's recovery"
+        ),
+        "value": b_budget,
+        "unit": "tenant-b in-deadline goodput frac (budgets on)",
+        "vs_baseline": round(b_budget / max(b_shared, 1e-9), 2),
+        "part1_ok": part1_ok,
+        "part2_ok": part2_ok,
+        "arms": {"budgets": arm_budgets, "shared": arm_shared},
+        "brownout_arm": part2,
+        "capacity_rps": round(capacity_rps, 1),
+        "rate_x": rate_x,
+        "seed": seed,
+    }
+    return out, 0 if ok else 1
+
+
 def bench_obs_overhead_ab(duration_s=5.0, device_ms=0.0, clients=16,
                           buckets=(1, 2, 4, 8), deadline_ms=2000.0,
                           rounds=2):
@@ -3581,6 +3978,46 @@ def main() -> int:
         help="light-model offered request rate for --multimodel-ab",
     )
     p.add_argument(
+        "--tenant-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: tenant isolation + brownout acceptance "
+             "-- part 1 drives two stub tenants on one model tier (tenant-a "
+             "at --tenant-rate-x times capacity) for this many seconds per "
+             "arm, per-model admission budgets vs the legacy shared "
+             "limiter; part 2 floods a real gateway with best-effort "
+             "traffic and proves the SLO-burn brownout ladder climbs, "
+             "sheds, recovers, and never flaps (no device needed; rc=0 iff "
+             "tenant-b holds >=95% in-deadline goodput under budgets while "
+             "the shared baseline collapses, AND the brownout arm ends "
+             "with 5m burn < 1.0, interactive goodput >= 95%, peak stage "
+             ">= 3, zero flaps)",
+    )
+    p.add_argument(
+        "--tenant-device-ms", type=float, default=50.0,
+        help="simulated device ms per batch for the --tenant-ab stub tier "
+             "(sets capacity: max-bucket / device-ms)",
+    )
+    p.add_argument(
+        "--tenant-deadline-ms", type=float, default=1500.0,
+        help="per-request deadline budget for --tenant-ab part 1",
+    )
+    p.add_argument(
+        "--tenant-rate-x", type=float, default=3.0,
+        help="tenant-a offered load as a multiple of the tier's capacity",
+    )
+    p.add_argument(
+        "--tenant-b-rps", type=float, default=12.0,
+        help="victim tenant-b offered rate for --tenant-ab (must be "
+             "comfortably under capacity)",
+    )
+    p.add_argument(
+        "--tenant-flood-s", type=float, default=6.0,
+        help="--tenant-ab part 2 best-effort flood duration",
+    )
+    p.add_argument(
+        "--tenant-seed", type=int, default=0,
+        help="deterministic seed for the --tenant-ab fixtures",
+    )
+    p.add_argument(
         "--quant-ab", type=int, default=0, metavar="REPS",
         help="INSTEAD of the sweep: full-int8 quantization A/B -- f32 vs "
              "int8-weight-only vs calibrated int8-w8a8 InferenceEngines on "
@@ -3841,7 +4278,8 @@ def main() -> int:
         for flag in ("soak", "child_batch", "pipeline_ab", "crosshost_ab",
                      "batcher_sweep", "host_saturation", "overload_ab",
                      "chaos_ab", "churn_ab", "cache_ab", "trace_breakdown",
-                     "multimodel_ab", "obs_overhead_ab", "quant_ab"):
+                     "multimodel_ab", "obs_overhead_ab", "quant_ab",
+                     "tenant_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -3920,6 +4358,15 @@ def main() -> int:
                 "light_deadline_ms": args.mm_light_deadline_ms,
                 "rate_x": args.mm_rate_x,
                 "light_rps": args.mm_light_rps,
+            },
+            "tenant": {
+                "duration_s": args.tenant_ab,
+                "device_ms": args.tenant_device_ms,
+                "deadline_ms": args.tenant_deadline_ms,
+                "rate_x": args.tenant_rate_x,
+                "b_rps": args.tenant_b_rps,
+                "flood_s": args.tenant_flood_s,
+                "seed": args.tenant_seed,
             },
             "crosshost": {
                 "rounds": args.crosshost_ab,
@@ -4045,6 +4492,19 @@ def main() -> int:
             probe_interval_s=args.churn_probe_s,
             resolve_interval_s=args.churn_resolve_s,
             seed=args.churn_seed,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.tenant_ab > 0:
+        out, rc = bench_tenant_ab(
+            duration_s=args.tenant_ab,
+            device_ms=args.tenant_device_ms,
+            deadline_ms=args.tenant_deadline_ms,
+            rate_x=args.tenant_rate_x,
+            b_rps=args.tenant_b_rps,
+            flood_s=args.tenant_flood_s,
+            seed=args.tenant_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

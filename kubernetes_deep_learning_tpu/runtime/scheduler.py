@@ -82,6 +82,16 @@ SHARE_HALFLIFE_S = 10.0
 # weighted policy therefore degrades to FIFO, which is the legacy behavior.
 DEFAULT_SLACK_S = 20.0
 
+# Priority classes modulate a unit's EFFECTIVE deadline (ordering only --
+# the real deadline still decides timeouts): lower classes concede this
+# much slack, so an interactive unit outranks a batch unit enqueued with
+# the same budget, without ever starving the lower class outright (its
+# relaxed deadline still comes due).  The class names mirror
+# serving.protocol.PRIORITY_CLASSES; spelled locally because the runtime
+# layer sits below the serving wire contract.  Unknown/absent classes get
+# zero slack (legacy submitters keep their exact ordering).
+PRIORITY_SLACK_S = {"interactive": 0.0, "batch": 1.0, "best-effort": 5.0}
+
 
 def resolve_policy(policy: str | None = None) -> str:
     """Explicit arg > $KDLT_SCHED_POLICY > weighted_deadline.  Unknown
@@ -119,10 +129,10 @@ class _Unit:
 
     __slots__ = (
         "images", "n", "future", "deadline_abs", "trace", "enq_t", "enq_w",
-        "single",
+        "single", "priority",
     )
 
-    def __init__(self, images, n, deadline_abs, trace, single):
+    def __init__(self, images, n, deadline_abs, trace, single, priority=None):
         self.images = images
         self.n = n
         self.future: Future = Future()
@@ -131,6 +141,7 @@ class _Unit:
         self.enq_t = time.monotonic()
         self.enq_w = trace_lib.now_s() if trace is not None else 0.0
         self.single = single  # resolve to one row (True) or the row block
+        self.priority = priority  # PRIORITY_SLACK_S key, or None (legacy)
 
 
 class Lane:
@@ -206,6 +217,7 @@ class Lane:
                 u.deadline_abs if u.deadline_abs is not None
                 else u.enq_t + DEFAULT_SLACK_S
             )
+            + PRIORITY_SLACK_S.get(u.priority, 0.0)
             for u in self.queue
         )
         return earliest - est
@@ -320,26 +332,33 @@ class UnifiedScheduler:
     # --- request intake -----------------------------------------------------
 
     def submit(self, model: str, image: np.ndarray, deadline=None,
-               trace=None) -> Future:
+               trace=None, priority=None) -> Future:
         """One HWC uint8 image; the future resolves to its logits row.
 
         ``deadline`` is a serving.admission Deadline (or None); its
         remaining budget becomes the request's absolute deadline in the
-        arbitration order.  ``trace`` gets the ``batcher.queue_wait`` span
-        plus the pipeline-stage spans, exactly like the batchers."""
+        arbitration order.  ``priority`` (a PRIORITY_SLACK_S key) relaxes
+        the unit's effective deadline for lower classes.  ``trace`` gets
+        the ``batcher.queue_wait`` span plus the pipeline-stage spans,
+        exactly like the batchers."""
         image = np.asarray(image)
-        return self._enqueue(model, image[None], 1, deadline, trace, single=True)
+        return self._enqueue(
+            model, image[None], 1, deadline, trace, single=True,
+            priority=priority,
+        )
 
     def submit_batch(self, model: str, images: np.ndarray, deadline=None,
-                     trace=None) -> Future:
+                     trace=None, priority=None) -> Future:
         """A pre-formed uint8 chunk (n <= the model's max bucket); the
         future resolves to its n logits rows, contiguous and in order."""
         images = np.asarray(images)
         return self._enqueue(
-            model, images, images.shape[0], deadline, trace, single=False
+            model, images, images.shape[0], deadline, trace, single=False,
+            priority=priority,
         )
 
-    def _enqueue(self, model, images, n, deadline, trace, single) -> Future:
+    def _enqueue(self, model, images, n, deadline, trace, single,
+                 priority=None) -> Future:
         if images.dtype != np.uint8:
             raise ValueError(f"scheduler takes uint8 images, got {images.dtype}")
         deadline_abs = None
@@ -364,7 +383,8 @@ class UnifiedScheduler:
             if lane.pending_images + n > lane.queue_cap:
                 lane.m["queue_full"].inc()
                 raise QueueFull(f"request queue full for model {model!r}")
-            unit = _Unit(images, n, deadline_abs, trace, single)
+            unit = _Unit(images, n, deadline_abs, trace, single,
+                         priority=priority)
             lane.queue.append(unit)
             lane.pending_images += n
             lane.m["queue_depth"].set(float(lane.pending_images))

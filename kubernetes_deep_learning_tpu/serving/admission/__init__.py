@@ -23,6 +23,10 @@ completions/s) under 2x offered load with admission on vs off.
 """
 
 from kubernetes_deep_learning_tpu.serving.admission.breaker import CircuitBreaker
+from kubernetes_deep_learning_tpu.serving.admission.brownout import (
+    BrownoutController,
+    brownout_enabled,
+)
 from kubernetes_deep_learning_tpu.serving.admission.controller import (
     AdmissionController,
     Ticket,
@@ -35,7 +39,11 @@ from kubernetes_deep_learning_tpu.serving.admission.deadline import (
     WSGI_DEADLINE_KEY,
     Deadline,
 )
-from kubernetes_deep_learning_tpu.serving.admission.limiter import AdaptiveLimiter
+from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+    AdaptiveLimiter,
+    env_budgets,
+    parse_budgets,
+)
 from kubernetes_deep_learning_tpu.serving.admission.shed import (
     RETRY_AFTER_HEADER,
     Shed,
@@ -45,6 +53,7 @@ from kubernetes_deep_learning_tpu.serving.admission.shed import (
 __all__ = [
     "AdaptiveLimiter",
     "AdmissionController",
+    "BrownoutController",
     "CircuitBreaker",
     "DEADLINE_HEADER",
     "Deadline",
@@ -53,7 +62,10 @@ __all__ = [
     "Ticket",
     "WSGI_DEADLINE_KEY",
     "admission_enabled",
+    "brownout_enabled",
     "drain_timeout_s",
+    "env_budgets",
     "install_sigterm_drain",
+    "parse_budgets",
     "retry_after_headers",
 ]

@@ -1,0 +1,244 @@
+"""Brownout: SLO-burn-driven staged graceful degradation (gateway tier).
+
+Admission control (limiter.py) protects the tier from *instantaneous*
+overload -- queue depth and concurrency.  This module closes the slower
+loop: when the fleet is persistently missing its SLO (the PR 7 burn rate
+over the fast window stays above sustainable), the gateway walks a ladder
+of progressively cheaper serving modes instead of letting every class of
+traffic degrade equally:
+
+====== ===================================================================
+stage  degradation (cumulative -- stage 3 includes 1 and 2)
+====== ===================================================================
+1      hedged retries disabled (hedges add load exactly when the tier
+       can least afford duplicate work)
+2      stale-while-revalidate cache serves: TTL-expired 200s within the
+       ``KDLT_CACHE_SWR_S`` window answer immediately, marked
+       ``X-Kdlt-Cache: stale``
+3      ``best-effort`` requests shed at the gateway (429, reason
+       ``brownout``)
+4      ``batch`` requests shed too -- only ``interactive`` still served
+====== ===================================================================
+
+The controller is a hysteresis state machine, never a thermostat that
+flaps: stage ``s`` is entered only when burn >= ``enter * s`` and left
+only when burn < ``exit * s`` (``exit`` strictly below ``enter`` leaves a
+dead band), it moves at most ONE stage per evaluation, and any two
+transitions are separated by ``KDLT_BROWNOUT_DWELL_S`` seconds of dwell.
+Class sheds use 429 (a *client*-class outcome in slo.classify), so the
+load the brownout sheds leaves the SLO denominator and the burn signal
+can actually recover -- shedding with 503 would keep burn pinned high and
+latch the ladder at max stage.
+
+Metrics (``kdlt_brownout_stage``, ``kdlt_brownout_transitions_total``)
+are minted centrally in utils.metrics; ``/debug/brownout`` on the gateway
+exposes the live stage, thresholds, and transition history.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+BROWNOUT_ENV = "KDLT_BROWNOUT"
+BURN_ENTER_ENV = "KDLT_BROWNOUT_BURN_ENTER"
+BURN_EXIT_ENV = "KDLT_BROWNOUT_BURN_EXIT"
+DWELL_ENV = "KDLT_BROWNOUT_DWELL_S"
+
+# Stage s enters at burn >= DEFAULT_BURN_ENTER * s: 2/4/6/8 with the
+# defaults.  Burn 2.0 over 5 m means the error budget is draining at twice
+# the sustainable rate -- degrading hedges is cheap insurance there, while
+# shedding whole classes (6x/8x) is reserved for genuine incidents.
+DEFAULT_BURN_ENTER = 2.0
+# Stage s exits below DEFAULT_BURN_EXIT * s; strictly below enter so the
+# [exit*s, enter*(s+1)) band is where a stage holds steady.
+DEFAULT_BURN_EXIT = 1.0
+DEFAULT_DWELL_S = 10.0
+MAX_STAGE = 4
+
+# Which SloEngine window feeds the ladder: the fast (reaction-time) one.
+BURN_WINDOW = "5m"
+
+STAGE_ACTIONS = {
+    1: "hedging disabled",
+    2: "stale cache serves",
+    3: "shed best-effort",
+    4: "shed batch",
+}
+
+_HISTORY_CAP = 64
+
+
+def brownout_enabled(explicit: bool | None = None) -> bool:
+    """Explicit arg > $KDLT_BROWNOUT > enabled-by-default (the ladder only
+    acts when burn is already well past sustainable, so the default-on
+    posture matches the other serving subsystems' kill switches)."""
+    if explicit is not None:
+        return bool(explicit)
+    raw = os.environ.get(BROWNOUT_ENV, "").strip().lower()
+    return raw not in ("0", "false", "off", "no")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+class BrownoutController:
+    """The gateway's degradation ladder; evaluate() is called off the hot
+    path (a ~1 s daemon loop), the read properties are lock-cheap hot-path
+    gates.  ``clock`` is injectable for the fake-clock hysteresis tests.
+    """
+
+    def __init__(
+        self,
+        slo,
+        registry: metrics_lib.Registry | None = None,
+        enabled: bool | None = None,
+        burn_enter: float | None = None,
+        burn_exit: float | None = None,
+        dwell_s: float | None = None,
+        window: str = BURN_WINDOW,
+        clock=time.monotonic,
+    ):
+        slo_on = slo is not None and getattr(slo, "enabled", False)
+        self.enabled = brownout_enabled(enabled) and slo_on
+        self.slo = slo
+        self.window = window
+        self.burn_enter = max(1e-6, (
+            burn_enter if burn_enter is not None
+            else _env_float(BURN_ENTER_ENV, DEFAULT_BURN_ENTER)
+        ))
+        exit_ = (
+            burn_exit if burn_exit is not None
+            else _env_float(BURN_EXIT_ENV, DEFAULT_BURN_EXIT)
+        )
+        # Hysteresis requires exit strictly under enter; a misconfigured
+        # pair degrades to a half-band rather than a flapping ladder.
+        if not 0.0 < exit_ < self.burn_enter:
+            exit_ = self.burn_enter / 2.0
+        self.burn_exit = exit_
+        self.dwell_s = max(0.0, (
+            dwell_s if dwell_s is not None
+            else _env_float(DWELL_ENV, DEFAULT_DWELL_S)
+        ))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stage = 0
+        self._last_burn = 0.0
+        self._last_transition_t: float | None = None
+        self.transitions: list[dict] = []
+        self._m = (
+            metrics_lib.brownout_metrics(registry)
+            if registry is not None else None
+        )
+        if self._m is not None:
+            self._m["stage"].set(0.0)
+
+    # --- hot-path gates -----------------------------------------------------
+
+    @property
+    def stage(self) -> int:
+        return self._stage
+
+    @property
+    def hedging_disabled(self) -> bool:
+        return self._stage >= 1
+
+    @property
+    def serve_stale(self) -> bool:
+        return self._stage >= 2
+
+    def sheds(self, priority: str) -> bool:
+        """Whether the current stage sheds this priority class at the
+        door.  ``interactive`` is never brownout-shed -- protecting it is
+        the point of the ladder."""
+        stage = self._stage
+        if priority == "best-effort":
+            return stage >= 3
+        if priority == "batch":
+            return stage >= 4
+        return False
+
+    # --- control loop -------------------------------------------------------
+
+    def max_burn(self) -> float:
+        """The signal: the worst per-model burn rate over the fast window
+        (max, not mean -- one tenant's incident must not be averaged away
+        by a healthy fleet)."""
+        if self.slo is None or not getattr(self.slo, "enabled", False):
+            return 0.0
+        worst = 0.0
+        for windows in self.slo.model_windows().values():
+            row = windows.get(self.window)
+            if row:
+                worst = max(worst, float(row.get("burn_rate", 0.0)))
+        return worst
+
+    def evaluate(self) -> int:
+        """One control-loop tick: move at most one stage, respecting the
+        thresholds and the dwell; returns the (possibly new) stage."""
+        if not self.enabled:
+            return self._stage
+        burn = self.max_burn()
+        now = self._clock()
+        with self._lock:
+            self._last_burn = burn
+            stage = self._stage
+            next_stage = stage
+            if stage < MAX_STAGE and burn >= self.burn_enter * (stage + 1):
+                next_stage = stage + 1
+            elif stage > 0 and burn < self.burn_exit * stage:
+                next_stage = stage - 1
+            if next_stage == stage:
+                return stage
+            if (
+                self._last_transition_t is not None
+                and now - self._last_transition_t < self.dwell_s
+            ):
+                return stage  # dwell: hold the current stage
+            direction = "up" if next_stage > stage else "down"
+            # The label is the boundary stage crossed: entering s is
+            # (s, up); leaving s is (s, down) -- max(old, new) either way.
+            boundary = max(stage, next_stage)
+            self._stage = next_stage
+            self._last_transition_t = now
+            self.transitions.append({
+                "t": round(now, 3),
+                "from": stage,
+                "to": next_stage,
+                "burn": round(burn, 4),
+            })
+            del self.transitions[:-_HISTORY_CAP]
+            if self._m is not None:
+                self._m["stage"].set(float(next_stage))
+                counter = self._m["transitions"].get((boundary, direction))
+                if counter is not None:
+                    counter.inc()
+            return next_stage
+
+    # --- observability ------------------------------------------------------
+
+    def debug_payload(self) -> dict:
+        """The /debug/brownout JSON body."""
+        with self._lock:
+            stage = self._stage
+            return {
+                "enabled": self.enabled,
+                "stage": stage,
+                "burn": round(self._last_burn, 4),
+                "window": self.window,
+                "burn_enter": self.burn_enter,
+                "burn_exit": self.burn_exit,
+                "dwell_s": self.dwell_s,
+                "actions": [
+                    STAGE_ACTIONS[s] for s in range(1, stage + 1)
+                ],
+                "transitions": list(self.transitions),
+            }

@@ -25,6 +25,7 @@ import time
 from kubernetes_deep_learning_tpu.serving.admission.deadline import Deadline
 from kubernetes_deep_learning_tpu.serving.admission.limiter import AdaptiveLimiter
 from kubernetes_deep_learning_tpu.serving.admission.shed import Shed
+from kubernetes_deep_learning_tpu.serving.protocol import DEFAULT_PRIORITY
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
 ADMISSION_ENV = "KDLT_ADMISSION"
@@ -66,6 +67,7 @@ class Ticket:
 
     __slots__ = (
         "_controller", "queue_wait_s", "_deadline", "_overloaded", "_released",
+        "model", "_t0",
     )
 
     def __init__(
@@ -73,12 +75,15 @@ class Ticket:
         controller: "AdmissionController",
         queue_wait_s: float,
         deadline: Deadline | None = None,
+        model: str | None = None,
     ):
         self._controller = controller
         self.queue_wait_s = queue_wait_s
         self._deadline = deadline
         self._overloaded = False
         self._released = False
+        self.model = model
+        self._t0 = time.monotonic()
 
     def mark_overloaded(self) -> None:
         self._overloaded = True
@@ -95,7 +100,10 @@ class Ticket:
             )
             overloaded = overloaded or spent_fraction > LATENCY_CONGESTION_FRACTION
             headroom = spent_fraction < LATENCY_HEADROOM_FRACTION
-        self._controller._release(self.queue_wait_s, overloaded, headroom)
+        self._controller._release(
+            self.queue_wait_s, overloaded, headroom,
+            model=self.model, held_s=time.monotonic() - self._t0,
+        )
 
 
 class AdmissionController:
@@ -114,6 +122,10 @@ class AdmissionController:
         )
         self._tier_registry = registry.with_labels(tier=tier)
         self._m = metrics_lib.admission_metrics(self._tier_registry)
+        # Per-priority-class admitted/shed (bounded `class` label, minted
+        # centrally): which class pays for an overload is the question the
+        # brownout gates and --tenant-ab read.
+        self._class_m = metrics_lib.admission_class_metrics(self._tier_registry)
         # Per-model kdlt_admission_* slices (bounded `model` label, minted
         # centrally): lazily created per model name the handlers pass in.
         self._model_m: dict[str, dict] = {}
@@ -137,6 +149,18 @@ class AdmissionController:
     def limit(self) -> float | None:
         return self._limiter.limit if self._limiter is not None else None
 
+    @property
+    def limiter(self) -> AdaptiveLimiter | None:
+        return self._limiter
+
+    def retry_after_s(self, fallback: float = 0.05) -> float:
+        """A live Retry-After for sheds decided outside the limiter: the
+        limiter's queue-depth/hold-time derivation (jittered) when one
+        exists, else the caller's fallback."""
+        if self._limiter is not None:
+            return self._limiter.retry_after_s()
+        return fallback
+
     def _model_metrics(self, model: str | None) -> dict | None:
         if model is None:
             return None
@@ -158,14 +182,21 @@ class AdmissionController:
             return mm
 
     def admit(
-        self, deadline: Deadline | None = None, model: str | None = None
+        self,
+        deadline: Deadline | None = None,
+        model: str | None = None,
+        priority: str = DEFAULT_PRIORITY,
     ) -> Ticket:
         """Admit or raise Shed.  Order: drain, deadline, concurrency.
 
         ``model`` attributes the decision to the per-model
-        kdlt_admission_* slice (the bounded ``model`` label); callers pass
-        it once routing has resolved a REGISTERED model name, which is
-        what keeps the label's value set bounded by the model registry.
+        kdlt_admission_* slice (the bounded ``model`` label) AND keys the
+        limiter's per-model budget; callers pass it once routing has
+        resolved a REGISTERED model name, which is what keeps the label's
+        value set bounded by the model registry.  ``priority`` (a
+        protocol.PRIORITY_CLASSES member, already normalized by
+        parse_priority) orders queue grants and eviction: the lowest class
+        sheds first.
         """
         mm = self._model_metrics(model)
         self._m["requests"].inc()
@@ -175,7 +206,7 @@ class AdmissionController:
             self._shed(Shed(
                 "draining", 503, retry_after_s=DRAIN_RETRY_AFTER_S,
                 detail=f"{self.tier} is draining for shutdown",
-            ))
+            ), priority=priority)
         if self.enabled and deadline is not None and deadline.expired:
             self._shed(Shed(
                 "deadline_exhausted", 504,
@@ -183,14 +214,16 @@ class AdmissionController:
                     f"deadline budget exhausted before execution "
                     f"({deadline.budget_s * 1e3:.0f}ms budget)"
                 ),
-            ))
+            ), priority=priority)
         queue_wait = 0.0
         if self._limiter is not None:
             budget = deadline.remaining_s() if deadline is not None else None
             try:
-                queue_wait = self._limiter.acquire(budget)
+                queue_wait = self._limiter.acquire(
+                    budget, model=model, priority=priority
+                )
             except Shed as e:
-                self._shed(e)
+                self._shed(e, priority=priority)
             self._m["limit"].set(self._limiter.limit)
         self._m["queue_wait"].observe(queue_wait)
         if deadline is not None:
@@ -198,23 +231,48 @@ class AdmissionController:
         self._m["admitted"].inc()
         if mm is not None:
             mm["admitted"].inc()
+        cm = self._class_m.get(priority)
+        if cm is not None:
+            cm["admitted"].inc()
         with self._lock:
             self._inflight += 1
             self._m["inflight"].set(float(self._inflight))
-        return Ticket(self, queue_wait, deadline if self.enabled else None)
+        return Ticket(
+            self, queue_wait, deadline if self.enabled else None, model=model
+        )
 
-    def _shed(self, e: Shed) -> None:
+    def _shed(self, e: Shed, priority: str | None = None) -> None:
         counter = self._m["shed"].get(e.reason)
         if counter is not None:
             counter.inc()
+        if priority is not None:
+            cm = self._class_m.get(priority)
+            if cm is not None:
+                cm["shed"].inc()
         raise e
 
-    def count_shed(self, reason: str) -> None:
+    def count_shed(self, reason: str, priority: str | None = None) -> None:
         """Record a shed decided OUTSIDE admit() (e.g. the gateway's circuit
-        breaker refusing the upstream call mid-request)."""
+        breaker refusing the upstream call mid-request, or a brownout class
+        shed ahead of admission)."""
         counter = self._m["shed"].get(reason)
         if counter is not None:
             counter.inc()
+        if priority is not None:
+            cm = self._class_m.get(priority)
+            if cm is not None:
+                cm["shed"].inc()
+
+    def class_stats(self) -> dict:
+        """Per-priority-class admitted/shed counts (the /debug/brownout and
+        kdlt-client --stats surface)."""
+        return {
+            cls: {
+                "admitted": m["admitted"].value,
+                "shed": m["shed"].value,
+            }
+            for cls, m in self._class_m.items()
+        }
 
     def count_coalesced(self, model: str | None = None) -> None:
         """Record a cache-coalesced singleflight follower: admitted-but-
@@ -230,10 +288,18 @@ class AdmissionController:
             mm["requests"].inc()
             mm["admitted"].inc()
 
-    def _release(self, queue_wait_s: float, overloaded: bool, headroom: bool) -> None:
+    def _release(
+        self,
+        queue_wait_s: float,
+        overloaded: bool,
+        headroom: bool,
+        model: str | None = None,
+        held_s: float | None = None,
+    ) -> None:
         if self._limiter is not None:
             self._limiter.release(
-                queue_wait_s, overloaded=overloaded, headroom=headroom
+                queue_wait_s, overloaded=overloaded, headroom=headroom,
+                model=model, held_s=held_s,
             )
             self._m["limit"].set(self._limiter.limit)
         with self._lock:

@@ -1,4 +1,5 @@
-"""Adaptive concurrency limiter + bounded admission queue (AIMD).
+"""Adaptive concurrency limiter + bounded admission queue (AIMD) with
+per-model budgets and priority classes.
 
 The static alternative -- a fixed thread/queue cap -- is wrong in both
 directions on a serving tier whose per-request cost varies with batch
@@ -17,6 +18,19 @@ concurrency the way TCP learns a path's bandwidth:
   ``cooldown_s`` so one burst's worth of misses counts as ONE congestion
   event, not thirty.
 
+The tier-wide AIMD limit is then PARTITIONED into per-model budgets
+(``KDLT_ADMIT_BUDGETS``; weights default to ``KDLT_SCHED_WEIGHTS`` so the
+admission split and the scheduler split agree).  Each model's share is
+``limit * w_m / sum(w of ACTIVE models)`` -- active meaning in-flight or
+queued -- so a single-model tier keeps the exact legacy behavior (its
+share IS the limit) and idle capacity is never wasted: a model past its
+share may still run on slots nobody else wants (work-conserving
+borrowing).  The teeth are at the queue: grants go to under-share waiters
+first (then higher priority class, then FIFO), and when the waiter cap is
+hit the evicted victim is the most over-share waiter first (borrowed
+slots preempt-shed first), then the lowest class, then the youngest.  A
+noisy neighbor therefore exhausts ITS budget, not the tier's.
+
 Requests beyond the limit wait in a bounded queue -- but never for their
 whole deadline: the wait is capped at ``queue_wait_fraction`` (default a
 quarter) of the remaining budget, so an admitted request always keeps the
@@ -24,16 +38,27 @@ bulk of its budget for actual execution (one that burned its budget
 queueing would be admitted only to miss its deadline on the device, the
 worst of both worlds).  Beyond ``queue_cap`` waiters, or past the wait
 bound, the request sheds with a distinct reason so dashboards can tell
-"queue overflowed" from "queue too slow".
+"queue overflowed" from "budget exhausted" from "queue too slow".
+
+Shed ``Retry-After`` hints are derived from live state -- queued waiters
+ahead of a retry times the observed slot-hold EWMA over the limit -- with
+±25% jitter, so a synchronized thundering herd of retriers decorrelates
+instead of re-arriving as one wave (the retry-storm failure mode a
+constant hint invites).
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 
 from kubernetes_deep_learning_tpu.serving.admission.shed import Shed
+from kubernetes_deep_learning_tpu.serving.protocol import (
+    DEFAULT_PRIORITY,
+    PRIORITY_RANK,
+)
 
 MAX_CONCURRENCY_ENV = "KDLT_ADMISSION_MAX_CONCURRENCY"
 MIN_CONCURRENCY_ENV = "KDLT_ADMISSION_MIN_CONCURRENCY"
@@ -41,6 +66,26 @@ INITIAL_CONCURRENCY_ENV = "KDLT_ADMISSION_INITIAL_CONCURRENCY"
 QUEUE_CAP_ENV = "KDLT_ADMISSION_QUEUE_CAP"
 TARGET_QUEUE_MS_ENV = "KDLT_ADMISSION_TARGET_QUEUE_MS"
 MAX_QUEUE_WAIT_MS_ENV = "KDLT_ADMISSION_MAX_QUEUE_WAIT_MS"
+# Per-model budget weights: "model=weight,..." enables explicit weights,
+# "0"/"off" disables partitioning (the legacy shared limiter), anything
+# else -- including unset -- enables budgets with the scheduler's
+# KDLT_SCHED_WEIGHTS weights (default weight 1.0 per model), so the
+# admission split and the device-time split agree by default.
+BUDGETS_ENV = "KDLT_ADMIT_BUDGETS"
+# Spelled locally (not imported from runtime.scheduler, which sits above
+# this layer) -- the grammar below matches scheduler.resolve_weights.
+SCHED_WEIGHTS_ENV = "KDLT_SCHED_WEIGHTS"
+
+_FALSY = {"0", "off", "false", "no"}
+_TRUTHY = {"", "1", "on", "true", "yes", "auto"}
+# Retry-After derivation bounds: never under 50ms (a tight loop of instant
+# retries), never over 10s (a confused EWMA must not park clients).
+RETRY_AFTER_MIN_S = 0.05
+RETRY_AFTER_MAX_S = 10.0
+RETRY_AFTER_JITTER = 0.25
+_HOLD_EWMA_ALPHA = 0.2
+
+_ENV_SENTINEL = object()
 
 
 def _env_float(name: str, default: float) -> float:
@@ -58,6 +103,52 @@ def env_max_limit(default: float = 64.0) -> float:
     return _env_float(MAX_CONCURRENCY_ENV, default)
 
 
+def parse_budgets(raw: str | None) -> dict[str, float]:
+    """"model=weight,..." -> weight map (scheduler.resolve_weights grammar:
+    malformed entries are skipped, weights floored at 1e-3)."""
+    out: dict[str, float] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, w = part.partition("=")
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            out[name] = max(float(w), 1e-3)
+        except ValueError:
+            continue
+    return out
+
+
+def env_budgets() -> dict[str, float] | None:
+    """Resolve KDLT_ADMIT_BUDGETS: None disables partitioning (legacy
+    shared limiter); a dict -- possibly empty, every model then weighing
+    1.0 -- enables it."""
+    raw = os.environ.get(BUDGETS_ENV, "").strip()
+    if raw.lower() in _FALSY:
+        return None
+    if raw.lower() in _TRUTHY:
+        raw = os.environ.get(SCHED_WEIGHTS_ENV, "")
+    return parse_budgets(raw)
+
+
+class _Waiter:
+    """One queued request: who it is (model, class), when it arrived, and
+    how it left the queue (granted a slot, or shed by an evictor)."""
+
+    __slots__ = ("model", "priority", "rank", "enq_t", "granted", "shed")
+
+    def __init__(self, model: str | None, priority: str, enq_t: float):
+        self.model = model
+        self.priority = priority
+        self.rank = PRIORITY_RANK.get(priority, 0)
+        self.enq_t = enq_t
+        self.granted = False
+        self.shed: Shed | None = None
+
+
 class AdaptiveLimiter:
     def __init__(
         self,
@@ -70,6 +161,7 @@ class AdaptiveLimiter:
         queue_wait_fraction: float = 0.25,
         decrease: float = 0.9,
         cooldown_s: float = 0.1,
+        budgets: dict[str, float] | None = _ENV_SENTINEL,  # type: ignore[assignment]
     ):
         self.min_limit = min_limit if min_limit is not None else max(
             1.0, _env_float(MIN_CONCURRENCY_ENV, 1.0)
@@ -113,8 +205,15 @@ class AdaptiveLimiter:
         self._cooldown_s = cooldown_s
         self._last_decrease = 0.0
         self._inflight = 0
-        self._waiters = 0
+        self._inflight_by: dict[str, int] = {}
+        self._waiters: list[_Waiter] = []
         self._cond = threading.Condition()
+        # Observed slot-hold EWMA (seconds held from admit to release), the
+        # live backlog-drain estimate behind derived Retry-After hints.
+        self._hold_ewma_s = 0.0
+        self.budgets: dict[str, float] | None = (
+            env_budgets() if budgets is _ENV_SENTINEL else budgets
+        )
 
     @property
     def limit(self) -> float:
@@ -124,63 +223,200 @@ class AdaptiveLimiter:
     def inflight(self) -> int:
         return self._inflight
 
+    @property
+    def queue_depth(self) -> int:
+        return len(self._waiters)
+
     def _slots_full(self) -> bool:
         return self._inflight >= max(1, int(self._limit))
 
-    def acquire(self, budget_s: float | None = None) -> float:
+    # --- per-model budget partitioning ---------------------------------
+
+    def _weight(self, model: str | None) -> float:
+        if self.budgets is None or model is None:
+            return 1.0
+        return self.budgets.get(model, 1.0)
+
+    def _share_locked(self, model: str | None) -> float:
+        """``model``'s budget: its weighted slice of the current limit over
+        the ACTIVE model set (in-flight or queued, plus itself).  With one
+        active model the share is the whole limit -- single-tenant tiers
+        keep the exact legacy AIMD behavior."""
+        if self.budgets is None or model is None:
+            return self._limit
+        active = set(self._inflight_by)
+        active.update(w.model for w in self._waiters if w.model is not None)
+        active.add(model)
+        total = sum(self._weight(m) for m in active)
+        if total <= 0:
+            return self._limit
+        return self._limit * self._weight(model) / total
+
+    def _over_share_locked(self, model: str | None) -> bool:
+        if self.budgets is None or model is None:
+            return False
+        return self._inflight_by.get(model, 0) >= self._share_locked(model)
+
+    def _take_slot_locked(self, model: str | None) -> None:
+        self._inflight += 1
+        if model is not None:
+            self._inflight_by[model] = self._inflight_by.get(model, 0) + 1
+
+    def shares(self) -> dict[str, float]:
+        """Current per-model budget shares (debug surface)."""
+        with self._cond:
+            if self.budgets is None:
+                return {}
+            active = set(self._inflight_by)
+            active.update(w.model for w in self._waiters if w.model is not None)
+            return {m: self._share_locked(m) for m in sorted(active)}
+
+    # --- derived Retry-After -------------------------------------------
+
+    def _retry_after_locked(self) -> float:
+        """Backlog-drain estimate: waiters ahead of a retry, served
+        ``limit`` at a time, each holding a slot for the observed EWMA.
+        Jittered ±25% so herds decorrelate; clamped so neither a cold
+        EWMA nor a deep queue produces a degenerate hint."""
+        hold = self._hold_ewma_s if self._hold_ewma_s > 0 else max(
+            self.target_wait_s, 0.1
+        )
+        base = (len(self._waiters) + 1) / max(self._limit, 1.0) * hold
+        base = min(max(base, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
+        return base * random.uniform(
+            1.0 - RETRY_AFTER_JITTER, 1.0 + RETRY_AFTER_JITTER
+        )
+
+    def retry_after_s(self) -> float:
+        with self._cond:
+            return self._retry_after_locked()
+
+    # --- queue arbitration ---------------------------------------------
+
+    def _grant_key(self, w: _Waiter) -> tuple:
+        # Under-share waiters first (the budget guarantee), then higher
+        # class (lower rank), then FIFO.
+        return (self._over_share_locked(w.model), w.rank, w.enq_t)
+
+    def _grant_waiters_locked(self) -> None:
+        """Hand free slots to the best waiters; wakes every waiter whose
+        state changed (granted or shed elsewhere)."""
+        woke = False
+        while self._waiters and not self._slots_full():
+            w = min(self._waiters, key=self._grant_key)
+            self._waiters.remove(w)
+            w.granted = True
+            self._take_slot_locked(w.model)
+            woke = True
+        if woke:
+            self._cond.notify_all()
+
+    def _evict_for_locked(self, model: str | None, rank: int) -> bool:
+        """Make room at the waiter cap for a (model, rank) arrival by
+        shedding the WORST queued waiter -- most over-share first (borrowed
+        slots preempt-shed first), then lowest class, then youngest -- but
+        only one strictly worse than the newcomer.  Returns False when the
+        newcomer itself is the worst (it should shed queue_full)."""
+        if not self._waiters:
+            return False
+
+        def victim_key(w: _Waiter) -> tuple:
+            return (self._over_share_locked(w.model), w.rank, w.enq_t)
+
+        victim = max(self._waiters, key=victim_key)
+        newcomer_key = (self._over_share_locked(model), rank, time.monotonic())
+        if victim_key(victim) <= newcomer_key:
+            return False
+        reason = (
+            "budget_exhausted" if self._over_share_locked(victim.model)
+            else "preempted"
+        )
+        victim.shed = Shed(
+            reason,
+            retry_after_s=self._retry_after_locked(),
+            detail=(
+                f"evicted from the admission queue by a "
+                f"{'under-budget' if reason == 'budget_exhausted' else 'higher-class'} "
+                f"arrival (model={victim.model!r}, class={victim.priority})"
+            ),
+        )
+        self._waiters.remove(victim)
+        self._cond.notify_all()
+        return True
+
+    def acquire(
+        self,
+        budget_s: float | None = None,
+        model: str | None = None,
+        priority: str = DEFAULT_PRIORITY,
+    ) -> float:
         """Take a concurrency slot; returns the queue wait in seconds.
 
         ``budget_s`` is the request's remaining deadline; the wait is
         bounded by ``queue_wait_fraction`` of it (and the absolute
         ``max_queue_wait_s``) so a queued request keeps enough budget to
-        actually execute.  Raises Shed("queue_full") when the waiter cap is
-        hit, Shed("queue_timeout") when no slot frees inside the bound.
+        actually execute.  ``model`` keys the per-model budget and
+        ``priority`` the class-ordered arbitration.  Raises
+        Shed("queue_full") when the waiter cap is hit and nobody worse can
+        be evicted, Shed("budget_exhausted"/"preempted") on eviction, and
+        Shed("queue_timeout") when no slot frees inside the bound.
         """
+        rank = PRIORITY_RANK.get(priority, 0)
         with self._cond:
-            if not self._slots_full():
-                self._inflight += 1
+            if not self._slots_full() and not self._waiters:
+                # Free slot, empty queue: take it.  Work-conserving
+                # borrowing happens exactly here -- an over-share model may
+                # run on capacity nobody is waiting for; the budget bites
+                # only once there IS contention (a queue).
+                self._take_slot_locked(model)
                 return 0.0
-            if self._waiters >= self.queue_cap:
-                raise Shed(
-                    "queue_full",
-                    retry_after_s=max(self.target_wait_s, 0.05),
-                    detail=f"admission queue at its {self.queue_cap}-waiter cap",
-                )
+            if len(self._waiters) >= self.queue_cap:
+                if not self._evict_for_locked(model, rank):
+                    raise Shed(
+                        "queue_full",
+                        retry_after_s=self._retry_after_locked(),
+                        detail=(
+                            f"admission queue at its {self.queue_cap}-waiter "
+                            f"cap with no lower-class or over-budget waiter "
+                            f"to evict"
+                        ),
+                    )
             bound = self.max_queue_wait_s
             if budget_s is not None:
                 bound = min(bound, max(0.0, budget_s) * self.queue_wait_fraction)
             t0 = time.monotonic()
             giveup = t0 + bound
-            self._waiters += 1
-            try:
-                while self._slots_full():
-                    remaining = giveup - time.monotonic()
-                    if remaining <= 0:
-                        # release() hands out a SINGLE notify; if it landed
-                        # on this waiter just as the bound expired, pass it
-                        # on -- otherwise the freed slot idles while the
-                        # remaining waiters sleep out their full bound and
-                        # shed despite available capacity.
-                        self._cond.notify()
-                        raise Shed(
-                            "queue_timeout",
-                            retry_after_s=max(self.target_wait_s, 0.05),
-                            detail=(
-                                f"no concurrency slot freed within "
-                                f"{bound * 1e3:.0f}ms (limit {self._limit:.1f})"
-                            ),
-                        )
-                    self._cond.wait(remaining)
-            finally:
-                self._waiters -= 1
-            self._inflight += 1
-            return time.monotonic() - t0
+            w = _Waiter(model, priority, t0)
+            self._waiters.append(w)
+            # A slot may be free right now (transiently, between a grant
+            # sweep and this arrival): sweep so the newcomer -- or a better
+            # waiter -- takes it immediately instead of on the next release.
+            self._grant_waiters_locked()
+            while True:
+                if w.granted:
+                    return time.monotonic() - t0
+                if w.shed is not None:
+                    raise w.shed
+                remaining = giveup - time.monotonic()
+                if remaining <= 0:
+                    self._waiters.remove(w)
+                    raise Shed(
+                        "queue_timeout",
+                        retry_after_s=self._retry_after_locked(),
+                        detail=(
+                            f"no concurrency slot freed within "
+                            f"{bound * 1e3:.0f}ms (limit {self._limit:.1f})"
+                        ),
+                    )
+                self._cond.wait(remaining)
 
     def release(
         self,
         queue_wait_s: float = 0.0,
         overloaded: bool = False,
         headroom: bool = True,
+        model: str | None = None,
+        held_s: float | None = None,
     ) -> None:
         """Free the slot and feed the AIMD controller.
 
@@ -192,10 +428,23 @@ class AdaptiveLimiter:
         "fast enough to grow" and "slow enough to shrink" is what keeps the
         equilibrium stable -- grow-on-every-success alone ratchets the
         limit up between cooldown-capped decreases until every completion
-        rides the deadline ceiling.
+        rides the deadline ceiling.  ``model`` mirrors acquire()'s and
+        ``held_s`` (admit -> release) feeds the Retry-After hold EWMA.
         """
         with self._cond:
             self._inflight = max(0, self._inflight - 1)
+            if model is not None and model in self._inflight_by:
+                left = self._inflight_by[model] - 1
+                if left > 0:
+                    self._inflight_by[model] = left
+                else:
+                    del self._inflight_by[model]
+            if held_s is not None and held_s >= 0:
+                self._hold_ewma_s = (
+                    held_s if self._hold_ewma_s <= 0
+                    else (1 - _HOLD_EWMA_ALPHA) * self._hold_ewma_s
+                    + _HOLD_EWMA_ALPHA * held_s
+                )
             now = time.monotonic()
             if overloaded or (
                 self.target_wait_s > 0 and queue_wait_s > self.target_wait_s
@@ -205,4 +454,7 @@ class AdaptiveLimiter:
                     self._last_decrease = now
             elif headroom:
                 self._limit = min(self.max_limit, self._limit + 1.0 / max(self._limit, 1.0))
-            self._cond.notify()
+            self._grant_waiters_locked()
+            # Even when nobody was granted (e.g. only over-bound waiters
+            # remain mid-timeout), wake the queue so timing loops re-check.
+            self._cond.notify_all()

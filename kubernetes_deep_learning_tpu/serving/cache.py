@@ -55,6 +55,7 @@ CACHE_ENV = "KDLT_CACHE"
 TTL_ENV = "KDLT_CACHE_TTL_S"
 MAX_MB_ENV = "KDLT_CACHE_MAX_MB"
 NEG_TTL_ENV = "KDLT_CACHE_NEG_TTL_S"
+SWR_ENV = "KDLT_CACHE_SWR_S"
 
 # Staleness ceiling between an artifact reload and the first miss that
 # teaches the gateway the new hash; 60 s matches the version watcher's
@@ -68,6 +69,12 @@ DEFAULT_MAX_MB = 64.0
 # upstream's transient state, not the request's.
 DEFAULT_NEG_TTL_S = 5.0
 NEGATIVE_STATUSES = (400, 404)
+
+# Stale-while-revalidate window: TTL-expired 200s stay resident for this
+# many extra seconds and can be served (marked stale) when the caller
+# opts in -- the brownout controller's stage-2 degradation.  0 disables
+# retention entirely, so the default cache behaves exactly as before.
+DEFAULT_SWR_S = 0.0
 
 # A client salt is hashed, never echoed, but still bound it: a multi-KB
 # header must not become free amplification of the hash input.
@@ -236,6 +243,7 @@ class ResponseCache:
         ttl_s: float | None = None,
         max_mb: float | None = None,
         neg_ttl_s: float | None = None,
+        swr_s: float | None = None,
     ):
         self.ttl_s = ttl_s if ttl_s is not None else _env_float(
             TTL_ENV, DEFAULT_TTL_S
@@ -245,6 +253,11 @@ class ResponseCache:
         self.neg_ttl_s = neg_ttl_s if neg_ttl_s is not None else _env_float(
             NEG_TTL_ENV, DEFAULT_NEG_TTL_S
         )
+        # Stale-while-revalidate retention past TTL for 200s only;
+        # servable exclusively through stale_ok lookups (brownout stage 2).
+        self.swr_s = max(0.0, swr_s if swr_s is not None else _env_float(
+            SWR_ENV, DEFAULT_SWR_S
+        ))
         max_mb = max_mb if max_mb is not None else _env_float(
             MAX_MB_ENV, DEFAULT_MAX_MB
         )
@@ -259,6 +272,7 @@ class ResponseCache:
         self.misses = 0
         self.coalesced = 0
         self.negative_hits = 0
+        self.stale_hits = 0
         self.evictions: dict[str, int] = {
             reason: 0 for reason, _ in metrics_lib.CACHE_EVICTION_REASONS
         }
@@ -334,12 +348,35 @@ class ResponseCache:
         coalesces, and counts it via count_miss / count_coalesced).
         Negative entries (status != 200) count as hits AND as
         negative_hits."""
+        got = self.lookup_swr(key, stale_ok=False)
+        return None if got is None else got[:3]
+
+    def lookup_swr(
+        self, key: str, stale_ok: bool = False,
+    ) -> tuple[int, bytes, str, bool] | None:
+        """lookup() plus the stale-while-revalidate window: a TTL-expired
+        200 stays resident for ``swr_s`` extra seconds and is served (with
+        the final tuple element True) ONLY when the caller passes
+        ``stale_ok`` -- the brownout controller's stage-2 degradation.
+        Without ``stale_ok`` an in-window entry answers None (the caller
+        leads a revalidating flight) but is NOT evicted, so a later
+        brownout can still use it.  Past ``expires + swr_s`` the entry is
+        gone regardless -- a stale serve can never outlive the window.
+        Negative entries never get SWR: a replayed 404 is pure harm."""
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
+            stale = False
             if entry is not None and entry.expires_s <= now:
-                self._evict_locked(key, "ttl")
-                entry = None
+                swr = self.swr_s if entry.status == 200 else 0.0
+                if now >= entry.expires_s + swr:
+                    self._evict_locked(key, "ttl")
+                    entry = None
+                elif stale_ok and entry.status == 200:
+                    stale = True
+                else:
+                    self._refresh_gauges_locked()
+                    return None
             if entry is None:
                 self._refresh_gauges_locked()
                 return None
@@ -347,11 +384,14 @@ class ResponseCache:
             entry.hits += 1
             self.hits += 1
             self._count("hits")
+            if stale:
+                self.stale_hits += 1
+                self._count("stale_hits")
             if entry.status != 200:
                 self.negative_hits += 1
                 self._count("neg_hits")
             self._refresh_gauges_locked()
-            return entry.status, entry.body, entry.ctype
+            return entry.status, entry.body, entry.ctype, stale
 
     def get(self, key: str) -> tuple[bytes, str] | None:
         """lookup() without the status (the original surface)."""
@@ -440,10 +480,12 @@ class ResponseCache:
                 "max_bytes": self.max_bytes,
                 "ttl_s": self.ttl_s,
                 "neg_ttl_s": self.neg_ttl_s,
+                "swr_s": self.swr_s,
                 "hits": self.hits,
                 "misses": self.misses,
                 "coalesced": self.coalesced,
                 "negative_hits": self.negative_hits,
+                "stale_hits": self.stale_hits,
                 "hit_ratio": round(self.hits / total, 4) if total else 0.0,
                 "evictions": dict(self.evictions),
                 "entries_by_model": per_model,

@@ -37,6 +37,7 @@ def predict_url(
     stats: dict | None = None,
     model: str | None = None,
     cache_bust: str | None = None,
+    priority: str | None = None,
 ) -> dict:
     """POST {"url": ...} to the gateway's /predict (reference test.py:15).
 
@@ -69,8 +70,14 @@ def predict_url(
     out of cached answers (a random salt per request defeats the cache
     entirely; a shared salt still coalesces identical concurrent
     requests).  The gateway's cache disposition for the served request
-    (hit | miss | coalesced, from the X-Kdlt-Cache response header) lands
-    in ``stats["cache"]``.
+    (hit | miss | coalesced | stale, from the X-Kdlt-Cache response
+    header) lands in ``stats["cache"]``.
+
+    ``priority`` states the request's class (interactive | batch |
+    best-effort) via the X-Kdlt-Priority header; under brownout the
+    gateway sheds the lowest classes first (429, reason "brownout") --
+    a 429 is NOT retried here: the ladder holds for at least its dwell
+    time, so an immediate retry is wasted load.
     """
     import requests
 
@@ -89,6 +96,8 @@ def predict_url(
         headers[protocol.MODEL_HEADER] = model
     if cache_bust is not None:
         headers[protocol.CACHE_BUST_HEADER] = cache_bust
+    if priority is not None:
+        headers[protocol.PRIORITY_HEADER] = priority
     t0 = time.monotonic()
     for attempt in range(retries + 1):
         try:
@@ -162,6 +171,39 @@ def fetch_slo(gateway_url: str, timeout: float = 5.0) -> dict:
     r = requests.get(f"{gateway_url}/debug/slo", timeout=timeout)
     r.raise_for_status()
     return r.json()
+
+
+def fetch_brownout(gateway_url: str, timeout: float = 5.0) -> dict:
+    """GET the gateway's /debug/brownout view: the degradation ladder's
+    live stage, burn vs thresholds, transition history, and the per-class
+    admitted/shed counters."""
+    import requests
+
+    r = requests.get(f"{gateway_url}/debug/brownout", timeout=timeout)
+    r.raise_for_status()
+    return r.json()
+
+
+def render_classes(payload: dict) -> str:
+    """ASCII rendering of /debug/brownout's per-class section: one row per
+    priority class (admitted, shed, goodput share) plus the ladder line."""
+    lines = [
+        f"brownout: stage {payload.get('stage', 0)} "
+        f"(burn {payload.get('burn', 0.0):.2f} over "
+        f"{payload.get('window', '5m')}; enter x{payload.get('burn_enter', 0)}"
+        f"/exit x{payload.get('burn_exit', 0)} per stage)"
+    ]
+    lines.append(
+        f"{'class':<14s} {'admitted':>9s} {'shed':>7s} {'goodput':>8s}"
+    )
+    for cls in protocol.PRIORITY_CLASSES:
+        row = (payload.get("classes") or {}).get(cls, {})
+        admitted = int(row.get("admitted", 0))
+        shed = int(row.get("shed", 0))
+        seen = admitted + shed
+        goodput = f"{admitted / seen:>8.4f}" if seen else f"{'-':>8s}"
+        lines.append(f"{cls:<14s} {admitted:>9d} {shed:>7d} {goodput}")
+    return "\n".join(lines)
 
 
 def fetch_pool(gateway_url: str, timeout: float = 5.0) -> dict:
@@ -268,6 +310,12 @@ def main(argv: list[str] | None = None) -> int:
         help="bounded retries on 503 shed responses (honors Retry-After)",
     )
     p.add_argument(
+        "--priority", default=None, choices=list(protocol.PRIORITY_CLASSES),
+        help="the request's priority class (X-Kdlt-Priority header): under "
+        "brownout the gateway sheds best-effort first, then batch; "
+        "default: interactive",
+    )
+    p.add_argument(
         "--cache-bust", action="store_true",
         help="salt the gateway's content-addressed response cache with a "
         "random X-Kdlt-Cache-Bust header so this request deliberately "
@@ -303,6 +351,7 @@ def main(argv: list[str] | None = None) -> int:
         retries=args.retries, deadline_ms=args.deadline_ms, stats=stats,
         model=args.model,
         cache_bust=uuid.uuid4().hex if args.cache_bust else None,
+        priority=args.priority,
     )
     print(json.dumps(scores, indent=2))
     if args.stats:
@@ -319,6 +368,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{'stat':<16s} value", file=sys.stderr)
         for name, value in rows:
             print(f"{name:<16s} {value}", file=sys.stderr)
+        # Per-class admitted/shed/goodput from /debug/brownout: which
+        # priority class is paying for an overload, plus the ladder stage.
+        try:
+            print(render_classes(fetch_brownout(args.gateway)), file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - diagnostics only
+            print(f"# brownout fetch failed: {e}", file=sys.stderr)
         # Per-replica rows from /debug/pool: picks + latency EWMA, so an
         # operator can watch a scale event rebalance traffic.
         try:

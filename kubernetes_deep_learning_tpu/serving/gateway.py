@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import re
 import sys
 import threading
@@ -43,6 +44,7 @@ from kubernetes_deep_learning_tpu.serving import protocol
 from kubernetes_deep_learning_tpu.serving.admission import (
     DEADLINE_HEADER,
     AdmissionController,
+    BrownoutController,
     Deadline,
     Shed,
     install_sigterm_drain,
@@ -77,6 +79,12 @@ DEFAULT_MODEL = "clothing-model"
 # Path wins over header (the more explicit signal).
 MODEL_HEADER = protocol.MODEL_HEADER
 WSGI_MODEL_KEY = "HTTP_X_KDLT_MODEL"
+# Priority classes: bounded X-Kdlt-Priority values, parsed once at the
+# transport edge (unknown/absent -> interactive) and propagated upstream.
+PRIORITY_HEADER = protocol.PRIORITY_HEADER
+WSGI_PRIORITY_KEY = "HTTP_X_KDLT_PRIORITY"
+# How often the brownout control loop re-reads the burn signal.
+BROWNOUT_EVAL_S = 1.0
 # Model names are path/label material: constrain them before they touch
 # URLs, metrics labels, or upstream requests.
 _MODEL_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
@@ -127,11 +135,18 @@ class Gateway:
         hedge_delay_ms: float | None = None,
         probe_interval_s: float | None = None,
         slo: bool | None = None,
+        slo_windows=None,
         cache: bool | None = None,
         cache_ttl_s: float | None = None,
         cache_max_mb: float | None = None,
         cache_neg_ttl_s: float | None = None,
+        cache_swr_s: float | None = None,
         pool_resolve_s: float | None = None,
+        brownout: bool | None = None,
+        brownout_enter: float | None = None,
+        brownout_exit: float | None = None,
+        brownout_dwell_s: float | None = None,
+        brownout_eval_s: float = BROWNOUT_EVAL_S,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -171,7 +186,13 @@ class Gateway:
         # burn-rate windows -- this tier sees what the user saw (including
         # failover/hedging saves the model tier's own view cannot know
         # about).  /debug/slo here also merges every replica's view.
-        self.slo = slo_lib.SloEngine(self.registry, tier="gateway", enabled=slo)
+        # slo_windows overrides the (label, seconds) window pair -- benches
+        # compress hours of burn dynamics into seconds while keeping the
+        # "5m" label contract the brownout ladder and dashboards key on.
+        self.slo = slo_lib.SloEngine(
+            self.registry, tier="gateway", enabled=slo,
+            windows=slo_windows if slo_windows is not None else slo_lib.WINDOWS,
+        )
         self._m_requests = self.registry.counter("kdlt_gateway_requests_total", "requests")
         self._m_errors = self.registry.counter("kdlt_gateway_errors_total", "errors")
         self._m_latency = self.registry.histogram(
@@ -189,6 +210,24 @@ class Gateway:
         self.admission = AdmissionController(
             self.registry, tier="gateway", enabled=admission
         )
+        # Brownout (serving.admission.brownout): the slow loop.  When the
+        # SLO burn rate stays unsustainable, the ladder degrades serving in
+        # stages -- hedges off, stale cache serves, then shedding the lower
+        # priority classes -- instead of every class failing together.  The
+        # evaluate() loop runs on its own ~1 s daemon, never the hot path.
+        self.brownout = BrownoutController(
+            self.slo, registry=self.registry, enabled=brownout,
+            burn_enter=brownout_enter, burn_exit=brownout_exit,
+            dwell_s=brownout_dwell_s,
+        )
+        self._brownout_eval_s = max(0.05, brownout_eval_s)
+        self._brownout_stop = threading.Event()
+        self._brownout_thread: threading.Thread | None = None
+        if self.brownout.enabled:
+            self._brownout_thread = threading.Thread(
+                target=self._brownout_loop, name="kdlt-brownout", daemon=True
+            )
+            self._brownout_thread.start()
         # Content-addressed response cache + singleflight coalescing
         # (serving.cache): checked AHEAD of admission, so a hit consumes no
         # AIMD concurrency slot, no preprocessing, and no upstream/device
@@ -199,7 +238,7 @@ class Gateway:
         self.cache = (
             cache_lib.ResponseCache(
                 self.registry, ttl_s=cache_ttl_s, max_mb=cache_max_mb,
-                neg_ttl_s=cache_neg_ttl_s,
+                neg_ttl_s=cache_neg_ttl_s, swr_s=cache_swr_s,
             )
             if cache_lib.cache_enabled(cache)
             else None
@@ -236,6 +275,33 @@ class Gateway:
             self._httpd.daemon_threads = True
             self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
+
+    # --- brownout control loop ---------------------------------------------
+
+    def _brownout_loop(self) -> None:
+        while not self._brownout_stop.wait(self._brownout_eval_s):
+            try:
+                self.brownout.evaluate()
+            except Exception:  # noqa: BLE001 - the loop must outlive a blip
+                continue
+
+    def _brownout_shed(self, priority: str) -> Shed:
+        """The 429 a brownout class-shed answers with.  429 (not 503) on
+        purpose: slo.classify files 4xx as client-class, so the load the
+        ladder sheds leaves the burn denominator and the signal can
+        recover instead of latching the ladder at max stage.  Retry-After
+        is the dwell (the soonest the stage can change), jittered so the
+        shed class cannot come back as one synchronized retry storm."""
+        dwell = max(self.brownout.dwell_s, 1.0)
+        return Shed(
+            "brownout",
+            http_status=429,
+            retry_after_s=dwell * random.uniform(0.75, 1.25),
+            detail=(
+                f"brownout stage {self.brownout.stage} sheds "
+                f"{priority} requests"
+            ),
+        )
 
     # --- model routing -----------------------------------------------------
 
@@ -447,7 +513,8 @@ class Gateway:
             )
 
     def _post_once(self, replica, body, request_id, deadline, timeout,
-                   span_id: str = "", model: str | None = None):
+                   span_id: str = "", model: str | None = None,
+                   priority: str | None = None):
         """One upstream POST to one replica (headers re-measured now)."""
         if self._faults is not None:
             self._faults.fire("gateway.upstream")
@@ -458,6 +525,8 @@ class Gateway:
             headers[PARENT_SPAN_HEADER] = span_id
         if deadline is not None:  # remaining budget, re-measured now
             headers[DEADLINE_HEADER] = deadline.header_value()
+        if priority:  # class propagation: the model tier sheds by class too
+            headers[PRIORITY_HEADER] = priority
         return self._session().post(
             f"{replica.base}/v1/models/{model or self.model}:predict",
             data=body,
@@ -466,7 +535,8 @@ class Gateway:
         )
 
     def _attempt_traced(self, replica, body, request_id, deadline, timeout,
-                        trace, role: str, model: str | None = None):
+                        trace, role: str, model: str | None = None,
+                        priority: str | None = None):
         """One upstream POST recorded as a ``gateway.upstream`` span.
 
         Returns ``(response, span)``; on failure records the span with the
@@ -477,14 +547,15 @@ class Gateway:
         """
         if trace is None:
             return self._post_once(
-                replica, body, request_id, deadline, timeout, model=model
+                replica, body, request_id, deadline, timeout, model=model,
+                priority=priority,
             ), None
         sid = trace_lib.new_span_id()
         w0 = trace_lib.now_s()
         try:
             r = self._post_once(
                 replica, body, request_id, deadline, timeout, span_id=sid,
-                model=model,
+                model=model, priority=priority,
             )
         except Exception as e:
             trace.tracer.record(
@@ -503,6 +574,7 @@ class Gateway:
     def _post_hedged(
         self, primary, body, request_id, deadline, timeout, tried,
         trace=None, role: str = "primary", model: str | None = None,
+        priority: str | None = None,
     ):
         """POST with a deadline-budget-aware hedged second attempt.
 
@@ -525,6 +597,9 @@ class Gateway:
         hedgeable = (
             pool.failover
             and delay > 0
+            # Brownout stage >= 1: hedges duplicate work exactly when the
+            # tier can least afford it, so they are the first thing to go.
+            and not self.brownout.hedging_disabled
             and pool.has_healthy_candidate(exclude=[primary, *tried])
             and (
                 deadline is None
@@ -534,7 +609,7 @@ class Gateway:
         if not hedgeable:
             r, span = self._attempt_traced(
                 primary, body, request_id, deadline, timeout, trace, role,
-                model=model,
+                model=model, priority=priority,
             )
             if span is not None:
                 span.tags["winner"] = True
@@ -547,7 +622,7 @@ class Gateway:
             try:
                 r, span = self._attempt_traced(
                     rep, body, request_id, deadline, timeout, trace, rep_role,
-                    model=model,
+                    model=model, priority=priority,
                 )
                 results.put((rep, r, None, span))
             except Exception as e:  # noqa: BLE001 - reported via the queue
@@ -657,6 +732,7 @@ class Gateway:
         deadline: Deadline | None = None,
         trace=None,
         model: str | None = None,
+        priority: str | None = None,
     ) -> tuple[list, list[str]]:
         """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
 
@@ -727,7 +803,7 @@ class Gateway:
                     replica, body, request_id, deadline, timeout, tried,
                     trace=trace,
                     role="failover" if tried else "primary",
-                    model=model,
+                    model=model, priority=priority,
                 )
             except (
                 requests.RequestException,
@@ -819,10 +895,13 @@ class Gateway:
         deadline: Deadline | None = None,
         trace=None,
         model: str | None = None,
+        priority: str | None = None,
     ) -> dict[str, float]:
         """url -> {label: score}; the reference's apply_model
         (reference model_server.py:52-56).  ``model`` routes to a
-        non-default served model (multi-model registry)."""
+        non-default served model (multi-model registry).  ``priority``
+        travels upstream on the direct path; micro-batched flushes mix
+        classes, so a coalesced upstream POST carries none."""
         image = self._fetch_one_traced(url, trace, model=model)
         microbatcher = self._microbatcher_for(model)
         if microbatcher is not None:
@@ -845,7 +924,8 @@ class Gateway:
                     )
             return dict(zip(labels, map(float, row)))
         logits, labels = self._predict_batch(
-            image[None], request_id, deadline, trace, model=model
+            image[None], request_id, deadline, trace, model=model,
+            priority=priority,
         )
         return dict(zip(labels, map(float, logits[0])))
 
@@ -856,6 +936,7 @@ class Gateway:
         deadline: Deadline | None = None,
         trace=None,
         model: str | None = None,
+        priority: str | None = None,
     ) -> list[dict]:
         """urls -> per-url {label: score} or {"error": ...}, order-preserving.
 
@@ -888,7 +969,7 @@ class Gateway:
 
             logits, labels = self._predict_batch(
                 np.stack([img for _, img in good]), request_id, deadline,
-                trace, model=model,
+                trace, model=model, priority=priority,
             )
             for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
@@ -941,6 +1022,15 @@ class Gateway:
                     **self.cache.stats(),
                     **self._singleflight.stats(),
                 }
+            return 200, json.dumps(payload).encode(), "application/json"
+        if path == "/debug/brownout":
+            # The degradation ladder's operator surface: live stage, burn
+            # vs the enter/exit thresholds, transition history, per-class
+            # admitted/shed counts, and the limiter's per-model shares.
+            payload = self.brownout.debug_payload()
+            payload["classes"] = self.admission.class_stats()
+            limiter = self.admission.limiter
+            payload["shares"] = limiter.shares() if limiter is not None else {}
             return 200, json.dumps(payload).encode(), "application/json"
         if path == "/debug/pool":
             # The replica pool's operator surface: membership, per-replica
@@ -1075,6 +1165,7 @@ class Gateway:
         model: str | None,
         routed: str,
         salt: str,
+        priority: str | None = None,
     ) -> tuple[int, bytes, str, dict[str, str]]:
         """The cache + singleflight front door for one single-url request.
 
@@ -1090,21 +1181,27 @@ class Gateway:
         """
         key = self._cache_key(routed, str(req.get("url", "")), salt)
         w0 = trace_lib.now_s()
-        cached = self.cache.lookup(key)
+        # Brownout stage >= 2: TTL-expired 200s within the SWR window are
+        # served immediately (marked "stale") instead of paying the full
+        # fetch path -- bounded staleness traded for shed load.
+        cached = self.cache.lookup_swr(
+            key, stale_ok=self.brownout.serve_stale
+        )
         if cached is not None:
             # Positive (200) or negative (recent 404/400 under the short
             # KDLT_CACHE_NEG_TTL_S) -- either way the full fetch path is
             # skipped; a negative hit still answers with ITS error status
             # and counts as this client's error.
-            hit_status, out, ctype = cached
+            hit_status, out, ctype, stale = cached
+            disposition = "stale" if stale else "hit"
             if hit_status != 200:
                 self._m_errors.inc()
             self.tracer.record(
                 rid, "gateway.cache", w0, trace_lib.now_s() - w0,
-                parent_id=rt.span_id, result="hit", status=hit_status,
+                parent_id=rt.span_id, result=disposition, status=hit_status,
             )
             return hit_status, out, ctype, {
-                cache_lib.CACHE_STATUS_HEADER: "hit"
+                cache_lib.CACHE_STATUS_HEADER: disposition
             }
         flight, leader = self._singleflight.begin(key)
         if not leader:
@@ -1122,7 +1219,7 @@ class Gateway:
                 # This waiter's own budget expired; the leader flies on for
                 # the others.
                 self._m_errors.inc()
-                self.admission.count_shed("deadline_exhausted")
+                self.admission.count_shed("deadline_exhausted", priority)
                 self.tracer.record(
                     rid, "gateway.cache", w0, trace_lib.now_s() - w0,
                     parent_id=rt.span_id, result="coalesced", outcome="timeout",
@@ -1164,7 +1261,8 @@ class Gateway:
         )
         try:
             status, out, ctype, extra, _n = self._predict_response(
-                body, req, rid, deadline, rt, model, routed
+                body, req, rid, deadline, rt, model, routed,
+                priority=priority,
             )
         except BaseException as e:
             # _predict_response maps every Exception; only process-fatal
@@ -1204,6 +1302,7 @@ class Gateway:
         rt,
         model: str | None,
         routed: str,
+        priority: str | None = None,
     ) -> tuple[int, bytes, str, dict[str, str], int]:
         """The admission -> parse -> preprocess -> upstream core of one
         /predict, every failure mapped to its client-facing response;
@@ -1220,7 +1319,10 @@ class Gateway:
         try:
             try:
                 with rt.span("gateway.admission"):
-                    ticket = self.admission.admit(deadline, model=routed)
+                    ticket = self.admission.admit(
+                        deadline, model=routed,
+                        priority=priority or protocol.DEFAULT_PRIORITY,
+                    )
             except Shed as e:
                 self._m_errors.inc()
                 return e.http_status, json.dumps(
@@ -1233,13 +1335,15 @@ class Gateway:
                 urls = list(req["urls"])
                 n_urls = len(urls)
                 preds = self.apply_model_batch(
-                    urls, rid, deadline, trace=rt, model=model
+                    urls, rid, deadline, trace=rt, model=model,
+                    priority=priority,
                 )
                 return 200, json.dumps(
                     {"predictions": preds}
                 ).encode(), "application/json", {}, n_urls
             scores = self.apply_model(
-                req["url"], rid, deadline, trace=rt, model=model
+                req["url"], rid, deadline, trace=rt, model=model,
+                priority=priority,
             )
             return 200, json.dumps(scores).encode(), "application/json", {}, n_urls
         except UpstreamError as e:
@@ -1264,7 +1368,9 @@ class Gateway:
                 ticket.mark_overloaded()
             return 503, json.dumps(
                 {"error": f"upstream unavailable: {e}"}
-            ).encode(), "application/json", retry_after_headers(0.05), n_urls
+            ).encode(), "application/json", retry_after_headers(
+                self.admission.retry_after_s()
+            ), n_urls
         except Exception as e:
             # Bad JSON, missing "url", unfetchable/undecodable image:
             # genuinely the caller's fault.
@@ -1283,6 +1389,7 @@ class Gateway:
         deadline: Deadline | None = None,
         model: str | None = None,
         cache_bust: str | None = None,
+        priority: str | None = None,
     ) -> tuple[int, bytes, str, dict[str, str]]:
         """POST /predict body -> (status, body, content_type, extra_headers).
 
@@ -1312,6 +1419,7 @@ class Gateway:
         if model is not None and model == self.model:
             model = None
         routed = model or self.model
+        priority = protocol.parse_priority(priority)
         # This request's trace (trace id = rid): the root span carrier every
         # child span -- admission, preprocess, upstream attempts -- nests
         # under, and the key /debug/trace/<rid> serves the waterfall by.
@@ -1324,6 +1432,17 @@ class Gateway:
         status = 500
         n_urls = 1
         try:
+            if self.brownout.sheds(priority):
+                # Stage 3/4 class shed, ahead of cache AND admission: the
+                # shed class's traffic must stop consuming anything --
+                # that is the capacity being handed back to interactive.
+                self._m_errors.inc()
+                self.admission.count_shed("brownout", priority)
+                e = self._brownout_shed(priority)
+                status = e.http_status
+                return status, json.dumps(
+                    {"error": str(e), "shed_reason": e.reason}
+                ).encode(), "application/json", e.headers()
             if deadline is None and self.admission.enabled:
                 deadline = Deadline.default()
             req = None
@@ -1341,11 +1460,12 @@ class Gateway:
             if req is not None:
                 status, out, ctype, extra = self._predict_coalesced(
                     body, req, rid, deadline, rt, model, routed,
-                    str(cache_bust or ""),
+                    str(cache_bust or ""), priority=priority,
                 )
             else:
                 status, out, ctype, extra, n_urls = self._predict_response(
-                    body, None, rid, deadline, rt, model, routed
+                    body, None, rid, deadline, rt, model, routed,
+                    priority=priority,
                 )
             return status, out, ctype, extra
         finally:
@@ -1447,6 +1567,7 @@ class Gateway:
                 status, out, ctype, extra = gw.handle_predict(
                     self.rfile.read(length), rid, deadline, model=model,
                     cache_bust=self.headers.get(cache_lib.CACHE_BUST_HEADER),
+                    priority=self.headers.get(PRIORITY_HEADER),
                 )
                 # Server-Timing-style span summary; handle_predict has
                 # recorded the full trace (root included) by return time.
@@ -1476,6 +1597,7 @@ class Gateway:
         self.admission.begin_drain()
 
     def shutdown(self) -> None:
+        self._brownout_stop.set()
         if self._microbatcher is not None:
             self._microbatcher.close()
         with self._microbatcher_lock:
@@ -1559,6 +1681,43 @@ def main(argv: list[str] | None = None) -> int:
         help="disable the content-addressed response cache AND singleflight "
         "request coalescing (serving.cache); default $KDLT_CACHE or enabled",
     )
+    p.add_argument(
+        "--cache-swr-s",
+        type=float,
+        default=None,
+        help="stale-while-revalidate window: TTL-expired 200s stay servable "
+        "(marked X-Kdlt-Cache: stale) for this many extra seconds under "
+        "brownout stage >= 2 (default $KDLT_CACHE_SWR_S or 0 = off)",
+    )
+    p.add_argument(
+        "--no-brownout",
+        action="store_true",
+        help="disable the SLO-burn-driven brownout ladder (hedges off -> "
+        "stale serves -> shed best-effort -> shed batch); default "
+        "$KDLT_BROWNOUT or enabled",
+    )
+    p.add_argument(
+        "--brownout-enter",
+        type=float,
+        default=None,
+        help="burn-rate multiple entering brownout stage s at enter*s "
+        "(default $KDLT_BROWNOUT_BURN_ENTER or 2.0)",
+    )
+    p.add_argument(
+        "--brownout-exit",
+        type=float,
+        default=None,
+        help="burn-rate multiple leaving brownout stage s below exit*s; "
+        "must stay under --brownout-enter for hysteresis (default "
+        "$KDLT_BROWNOUT_BURN_EXIT or 1.0)",
+    )
+    p.add_argument(
+        "--brownout-dwell-s",
+        type=float,
+        default=None,
+        help="minimum seconds between brownout stage transitions (default "
+        "$KDLT_BROWNOUT_DWELL_S or 10)",
+    )
     args = p.parse_args(argv)
     gw = Gateway(
         serving_host=args.serving_host,
@@ -1573,7 +1732,12 @@ def main(argv: list[str] | None = None) -> int:
         probe_interval_s=args.probe_interval_s,
         slo=False if args.no_slo else None,
         cache=False if args.no_cache else None,
+        cache_swr_s=args.cache_swr_s,
         pool_resolve_s=args.pool_resolve_s,
+        brownout=False if args.no_brownout else None,
+        brownout_enter=args.brownout_enter,
+        brownout_exit=args.brownout_exit,
+        brownout_dwell_s=args.brownout_dwell_s,
     )
     # SIGTERM -> flip /readyz, shed new work, finish in-flight, then stop;
     # pairs with the k8s terminationGracePeriodSeconds/preStop settings.

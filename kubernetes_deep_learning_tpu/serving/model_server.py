@@ -215,6 +215,7 @@ class ServedModel:
         images: np.ndarray,
         deadline: Deadline | None = None,
         trace=None,
+        priority: str | None = None,
     ) -> np.ndarray:
         # ``trace`` (utils.trace.RequestTrace): the handler's server.predict
         # span carrier; the batcher/dispatcher record this request's
@@ -243,12 +244,13 @@ class ServedModel:
             try:
                 if images.shape[0] == 1:
                     return self._scheduler.submit(
-                        self.name, images[0], deadline=deadline, trace=trace
+                        self.name, images[0], deadline=deadline, trace=trace,
+                        priority=priority,
                     ).result(timeout=batcher_timeout)[None]
                 futs = [
                     self._scheduler.submit_batch(
                         self.name, images[i : i + max_b],
-                        deadline=deadline, trace=trace,
+                        deadline=deadline, trace=trace, priority=priority,
                     )
                     for i in range(0, images.shape[0], max_b)
                 ]
@@ -772,6 +774,11 @@ class ModelServer:
                     if server.admission.enabled
                     else None
                 )
+                # Priority class (gateway-propagated or direct-client):
+                # bounded header values, unknown/absent -> interactive.
+                priority = protocol.parse_priority(
+                    self.headers.get(protocol.PRIORITY_HEADER)
+                )
                 ticket = None
                 try:
                     # Admission BEFORE the body is read or decoded: an
@@ -779,7 +786,7 @@ class ModelServer:
                     # never touch the TPU.
                     with rt.span("server.admission"):
                         ticket = server.admission.admit(
-                            deadline, model=m.group(1)
+                            deadline, model=m.group(1), priority=priority
                         )
                     if server._faults is not None:
                         # server.predict fault point: error/latency/hang/
@@ -828,7 +835,8 @@ class ModelServer:
                     batch = images.shape[0]
                     with rt.span("server.predict", batch=batch) as pt:
                         logits = model.predict(
-                            images, deadline=deadline, trace=pt
+                            images, deadline=deadline, trace=pt,
+                            priority=priority,
                         )
                     out, out_ctype = protocol.encode_predict_response(
                         logits, spec.labels, ctype
@@ -901,7 +909,12 @@ class ModelServer:
                     self._send_json(
                         503,
                         {"error": f"overloaded: {e or 'timed out'}"},
-                        headers=retry_after_headers(0.05),
+                        # Live, jittered backoff hint (queue depth x hold
+                        # time), so the shed cohort cannot return as one
+                        # synchronized retry storm.
+                        headers=retry_after_headers(
+                            server.admission.retry_after_s()
+                        ),
                     )
                 except Exception as e:  # internal failure
                     server._m_errors.inc()

@@ -54,6 +54,32 @@ ARTIFACT_HASH_HEADER = "X-Kdlt-Artifact-Hash"
 # transient evidence that takes consecutive failures to act on.
 STALLED_HEADER = "X-Kdlt-Stalled"
 
+# Request priority class (DAGOR-style bounded set).  Propagated
+# client -> gateway -> model tier so BOTH admission controllers shed the
+# lowest class first and the scheduler relaxes low-class effective
+# deadlines.  The set is closed by construction: an unknown or absent
+# header value falls back to the default, so the ``class`` metric label
+# stays bounded no matter what a caller sends.  Lives here -- the
+# wire-contract module -- so the dependency-light client can spell it
+# without importing the serving tiers.
+PRIORITY_HEADER = "X-Kdlt-Priority"
+PRIORITY_CLASSES = ("interactive", "batch", "best-effort")
+DEFAULT_PRIORITY = "interactive"
+# Shed order: HIGHER rank sheds first (best-effort before batch before
+# interactive); grant order is the reverse.
+PRIORITY_RANK = {name: rank for rank, name in enumerate(PRIORITY_CLASSES)}
+
+
+def parse_priority(raw: str | None) -> str:
+    """Normalize an X-Kdlt-Priority header value into the bounded class
+    set; anything absent, empty, or unrecognized is ``interactive`` (the
+    default must be the HIGHEST class: a legacy client that never heard of
+    priorities keeps its pre-priority service level)."""
+    if not raw:
+        return DEFAULT_PRIORITY
+    value = raw.strip().lower()
+    return value if value in PRIORITY_RANK else DEFAULT_PRIORITY
+
 
 def encode_tensor(arr: np.ndarray) -> dict[str, Any]:
     arr = np.ascontiguousarray(arr)

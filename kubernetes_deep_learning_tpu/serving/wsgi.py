@@ -48,7 +48,10 @@ class GatewayWSGI:
             ensure_request_id,
         )
 
-        from kubernetes_deep_learning_tpu.serving.gateway import WSGI_MODEL_KEY
+        from kubernetes_deep_learning_tpu.serving.gateway import (
+            WSGI_MODEL_KEY,
+            WSGI_PRIORITY_KEY,
+        )
 
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
@@ -85,6 +88,7 @@ class GatewayWSGI:
                     environ["wsgi.input"].read(length), rid, deadline,
                     model=model,
                     cache_bust=environ.get(WSGI_CACHE_BUST_KEY),
+                    priority=environ.get(WSGI_PRIORITY_KEY),
                 )
                 # Same span-summary header as the threaded transport.
                 summary = self.gateway.tracer.summary(rid)

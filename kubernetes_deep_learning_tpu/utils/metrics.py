@@ -405,7 +405,76 @@ ADMISSION_SHED_REASONS = (
     ("queue_full", "the admission queue's waiter cap was reached"),
     ("breaker_open", "the model-tier circuit breaker refused the call"),
     ("draining", "the tier is draining for shutdown"),
+    ("budget_exhausted", "the model's per-tenant admission budget was spent "
+                         "and no borrowed slot could be reclaimed"),
+    ("preempted", "a queued waiter was evicted by a higher-priority or "
+                  "under-budget arrival (borrowed slots shed first)"),
+    ("brownout", "rejected by the brownout controller's staged class "
+                 "shedding (429: the caller's class is out of budget, not "
+                 "a server failure)"),
 )
+
+# Priority classes (serving.protocol.PRIORITY_CLASSES): the bounded value
+# set of the ``class`` label on the per-class admission series.  Spelled
+# here too so the mint below cannot drift cardinality with a caller's
+# typo'd header -- admission normalizes through parse_priority first.
+ADMISSION_PRIORITY_CLASSES = ("interactive", "batch", "best-effort")
+
+
+def admission_class_metrics(registry: "Registry") -> dict:
+    """Per-priority-class admission accounting (admitted / shed), keyed by
+    the bounded ``class`` label.  One dict per tier registry: which class
+    is paying for an overload is THE question during a brownout, and
+    per-class goodput is what the ISSUE's class-shedding gates read."""
+    out: dict = {}
+    for cls in ADMISSION_PRIORITY_CLASSES:
+        child = registry.with_labels(**{"class": cls})
+        out[cls] = {
+            "admitted": child.counter(
+                "kdlt_admission_class_admitted_total",
+                "requests admitted to execution, by priority class",
+            ),
+            "shed": child.counter(
+                "kdlt_admission_class_shed_total",
+                "requests shed, by priority class (lowest class sheds first)",
+            ),
+        }
+    return out
+
+
+# Brownout controller (serving.admission.brownout): staged graceful
+# degradation driven by the SLO engine's burn rate.  kdlt_brownout_* is
+# minted HERE and nowhere else (tools/check_metrics.py confines the prefix
+# and the ``stage``/``direction`` labels to this module): the stage set is
+# exactly 1..4 and direction is up|down, both bounded by construction.
+BROWNOUT_STAGES = (1, 2, 3, 4)
+
+
+def brownout_metrics(registry: "Registry") -> dict:
+    """The brownout controller's series: the current stage (0 = healthy;
+    alert on ``kdlt_brownout_stage > 0``) and every stage-boundary
+    transition, labeled by the stage being entered (up) or left (down)."""
+    return {
+        "stage": registry.gauge(
+            "kdlt_brownout_stage",
+            "current brownout degradation stage (0 = off, 1 = hedging "
+            "disabled, 2 = stale-while-revalidate serving, 3 = shedding "
+            "best-effort, 4 = shedding batch)",
+        ),
+        "transitions": {
+            (stage, direction): registry.with_labels(
+                stage=str(stage), direction=direction
+            ).counter(
+                "kdlt_brownout_transitions_total",
+                "brownout stage transitions: direction=up counts entering "
+                "this stage from below, direction=down counts leaving it "
+                "downward (a flapping controller shows as paired up/down "
+                "increments)",
+            )
+            for stage in BROWNOUT_STAGES
+            for direction in ("up", "down")
+        },
+    }
 
 # Deadline budgets are ms-scale; the request-latency buckets (seconds) would
 # collapse every remaining-budget observation into two bins.
@@ -516,6 +585,12 @@ def cache_metrics(registry: "Registry") -> dict:
             "kdlt_cache_coalesced_total",
             "requests coalesced onto another identical request's in-flight "
             "upstream call (singleflight followers)",
+        ),
+        "stale_hits": registry.counter(
+            "kdlt_cache_stale_hits_total",
+            "requests served a TTL-expired entry under brownout "
+            "stale-while-revalidate (within KDLT_CACHE_SWR_S past expiry; "
+            "marked X-Kdlt-Cache: stale)",
         ),
         "neg_hits": registry.counter(
             "kdlt_cache_negative_hits_total",

@@ -403,3 +403,220 @@ def test_deadline_budget_propagates_across_tiers(tmp_path):
         gw.shutdown()
         server.shutdown()
         img_httpd.shutdown()
+
+
+# --- derived Retry-After: live queue/hold state, clamped, jittered ----------
+
+
+def test_retry_after_derived_from_queue_and_hold_ewma():
+    from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+        RETRY_AFTER_JITTER,
+        RETRY_AFTER_MAX_S,
+        RETRY_AFTER_MIN_S,
+        AdaptiveLimiter,
+    )
+
+    lim = AdaptiveLimiter(
+        min_limit=1, max_limit=4, initial=4, target_wait_s=0.0, budgets=None
+    )
+    lo = 1.0 - RETRY_AFTER_JITTER
+    hi = 1.0 + RETRY_AFTER_JITTER
+    # Cold EWMA: the 0.1 s fallback over 4 slots lands under the floor --
+    # the hint clamps to RETRY_AFTER_MIN_S BEFORE jitter is applied.
+    samples = [lim.retry_after_s() for _ in range(64)]
+    assert all(
+        RETRY_AFTER_MIN_S * lo <= s <= RETRY_AFTER_MIN_S * hi for s in samples
+    ), (min(samples), max(samples))
+    assert max(samples) > min(samples)  # jitter actually varies the hint
+    # Observed 2 s holds: (waiters+1)/limit * hold = 1/4 * 2 = 0.5 s base.
+    lim.release(held_s=2.0)
+    samples = [lim.retry_after_s() for _ in range(64)]
+    assert all(0.5 * lo <= s <= 0.5 * hi for s in samples), (
+        min(samples), max(samples),
+    )
+    # A confused EWMA (or a very deep queue) must not park clients: the
+    # base clamps at RETRY_AFTER_MAX_S, so the jittered hint never
+    # exceeds max * (1 + jitter).
+    lim._hold_ewma_s = 1_000.0
+    samples = [lim.retry_after_s() for _ in range(64)]
+    assert all(
+        RETRY_AFTER_MAX_S * lo <= s <= RETRY_AFTER_MAX_S * hi for s in samples
+    ), (min(samples), max(samples))
+
+
+def test_client_caps_honored_retry_after(monkeypatch):
+    # A server hinting Retry-After: 60 must not park the client for a
+    # minute: predict_url caps the honored value at RETRY_AFTER_CAP_S
+    # (plus its own decorrelation jitter) before sleeping.
+    import requests
+
+    from kubernetes_deep_learning_tpu.serving import client as client_mod
+
+    class Shed503:
+        status_code = 503
+        headers = {"Retry-After": "60"}
+
+        def raise_for_status(self):
+            raise requests.HTTPError("503", response=self)
+
+    slept: list[float] = []
+    monkeypatch.setattr(requests, "post", lambda *a, **kw: Shed503())
+    monkeypatch.setattr(client_mod.time, "sleep", slept.append)
+    stats: dict = {}
+    with pytest.raises(requests.HTTPError):
+        client_mod.predict_url(
+            "http://gw", "http://img", timeout=100.0, retries=1, stats=stats
+        )
+    assert stats["retried_shed"] == 1
+    assert len(slept) == 1
+    cap = client_mod.RETRY_AFTER_CAP_S
+    assert cap <= slept[0] <= cap * 1.25 + 0.01, slept
+
+
+# --- per-model budgets + priority classes in the limiter --------------------
+
+
+def _wait_for(predicate, timeout_s=2.0):
+    deadline = threading.Event()
+    for _ in range(int(timeout_s / 0.005)):
+        if predicate():
+            return True
+        deadline.wait(0.005)
+    return predicate()
+
+
+def test_budget_shares_follow_weights():
+    from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+        AdaptiveLimiter,
+    )
+
+    lim = AdaptiveLimiter(
+        min_limit=1, max_limit=8, initial=8, budgets={"a": 1.0, "b": 3.0}
+    )
+    lim.acquire(model="a")
+    lim.acquire(model="b")
+    # Weighted slices of the live limit over the ACTIVE model set.
+    assert lim.shares() == {"a": 2.0, "b": 6.0}
+    lim.release(model="b")
+    # b idle again: the sole active model owns the whole limit (work-
+    # conserving -- budgets bite only under contention).
+    assert lim.shares() == {"a": 8.0}
+
+
+def test_under_share_arrival_evicts_over_share_waiter():
+    from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+        AdaptiveLimiter,
+    )
+    from kubernetes_deep_learning_tpu.serving.admission.shed import Shed
+
+    lim = AdaptiveLimiter(
+        min_limit=1, max_limit=2, initial=2, queue_cap=1,
+        budgets={"a": 1.0, "b": 1.0},
+    )
+    # Tenant a takes BOTH slots (one borrowed from b's idle share) and
+    # queues a third request -- over-share, at the waiter cap.
+    lim.acquire(model="a")
+    lim.acquire(model="a")
+    outcome: dict = {}
+
+    def over_share_waiter():
+        try:
+            lim.acquire(budget_s=40.0, model="a")
+            outcome["a"] = "granted"
+        except Shed as e:
+            outcome["a"] = e
+
+    ta = threading.Thread(target=over_share_waiter)
+    ta.start()
+    assert _wait_for(lambda: lim.queue_depth == 1)
+    # b arrives at the cap: the over-share a waiter is strictly worse and
+    # is evicted (reason budget_exhausted -- the borrowed capacity is
+    # handed back first), with a live-derived Retry-After.
+    granted: list[float] = []
+    tb = threading.Thread(
+        target=lambda: granted.append(lim.acquire(budget_s=40.0, model="b"))
+    )
+    tb.start()
+    ta.join(timeout=5)
+    shed = outcome["a"]
+    assert isinstance(shed, Shed), shed
+    assert shed.reason == "budget_exhausted"
+    assert 0.0 < shed.retry_after_s <= 12.5
+    # The next freed slot goes to the under-share owner.
+    lim.release(model="a")
+    tb.join(timeout=5)
+    assert granted, "b's request was never granted"
+    assert lim.inflight == 2
+
+
+def test_higher_class_arrival_preempts_lower_class_waiter():
+    from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+        AdaptiveLimiter,
+    )
+    from kubernetes_deep_learning_tpu.serving.admission.shed import Shed
+
+    lim = AdaptiveLimiter(
+        min_limit=1, max_limit=2, initial=2, queue_cap=1, budgets=None
+    )
+    lim.acquire()
+    lim.acquire()
+    outcome: dict = {}
+
+    def lowly_waiter():
+        try:
+            lim.acquire(budget_s=40.0, priority="best-effort")
+            outcome["be"] = "granted"
+        except Shed as e:
+            outcome["be"] = e
+
+    t = threading.Thread(target=lowly_waiter)
+    t.start()
+    assert _wait_for(lambda: lim.queue_depth == 1)
+    granted: list[float] = []
+    ti = threading.Thread(
+        target=lambda: granted.append(
+            lim.acquire(budget_s=40.0, priority="interactive")
+        )
+    )
+    ti.start()
+    t.join(timeout=5)
+    shed = outcome["be"]
+    assert isinstance(shed, Shed), shed
+    assert shed.reason == "preempted"
+    lim.release()
+    ti.join(timeout=5)
+    assert granted, "the interactive request was never granted"
+
+
+def test_newcomer_no_better_than_queue_sheds_queue_full():
+    from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+        AdaptiveLimiter,
+    )
+    from kubernetes_deep_learning_tpu.serving.admission.shed import Shed
+
+    lim = AdaptiveLimiter(
+        min_limit=1, max_limit=2, initial=2, queue_cap=1, budgets=None
+    )
+    lim.acquire()
+    lim.acquire()
+    parked: dict = {}
+
+    def interactive_waiter():
+        try:
+            lim.acquire(budget_s=40.0, priority="interactive")
+            parked["i"] = "granted"
+        except Shed as e:
+            parked["i"] = e
+
+    t = threading.Thread(target=interactive_waiter)
+    t.start()
+    assert _wait_for(lambda: lim.queue_depth == 1)
+    # A best-effort arrival finds nobody strictly worse to evict: IT is
+    # the one shed, and the queued interactive request keeps its place.
+    with pytest.raises(Shed) as exc:
+        lim.acquire(budget_s=40.0, priority="best-effort")
+    assert exc.value.reason == "queue_full"
+    assert lim.queue_depth == 1
+    lim.release()
+    t.join(timeout=5)
+    assert parked["i"] == "granted"

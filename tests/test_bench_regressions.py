@@ -133,6 +133,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--cache-ab", "6"], "cache_ab"),
         (["--crosshost-ab", "30"], "crosshost_ab"),
         (["--obs-overhead-ab", "5"], "obs_overhead_ab"),
+        (["--tenant-ab", "5"], "tenant_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -415,6 +416,61 @@ def test_dry_run_quant_ab_echoes_the_quant_config():
     assert q["buckets"] == [1, 4]
     assert q["calib_images"] == 16
     assert q["min_size"] == 500000
+
+
+# --- tenant isolation + brownout A/B (ISSUE 12) ---------------------------
+
+
+def test_dry_run_tenant_ab_echoes_the_isolation_config():
+    # The --tenant-ab invocation surface (per-model budgets + brownout
+    # acceptance harness) must keep parsing and echo its resolved knobs
+    # without importing jax, binding ports, or spawning servers.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--tenant-ab", "5", "--dry-run",
+         "--tenant-device-ms", "40", "--tenant-deadline-ms", "1200",
+         "--tenant-rate-x", "2.5", "--tenant-b-rps", "10",
+         "--tenant-flood-s", "4", "--tenant-seed", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "tenant_ab"
+    t = out["tenant"]
+    assert t["duration_s"] == 5.0
+    assert t["device_ms"] == 40.0
+    assert t["deadline_ms"] == 1200.0
+    assert t["rate_x"] == 2.5
+    assert t["b_rps"] == 10.0
+    assert t["flood_s"] == 4.0
+    assert t["seed"] == 3
+
+
+@pytest.mark.slow
+def test_tenant_ab_budgets_isolate_and_brownout_recovers():
+    """ISSUE 12's acceptance bar (slow: two open-loop model-tier arms plus
+    a gateway brownout arm with a best-effort flood): with per-model
+    budgets, victim tenant-b holds >= 95% in-deadline goodput while
+    tenant-a floods at 3x capacity, vs collapse under the shared limiter;
+    the brownout ladder then climbs to >= stage 3 under the flood, keeps
+    interactive goodput >= 95%, recovers the 5m burn below 1.0, and walks
+    back down with ZERO up/down flaps."""
+    bench = _bench_module()
+    out, rc = bench.bench_tenant_ab(duration_s=4.0)
+    assert rc == 0, out
+    assert out["part1_ok"] is True, out
+    assert out["part2_ok"] is True, out
+    b_budget = out["arms"]["budgets"]["models"]["tenant-b"]["goodput_frac"]
+    b_shared = out["arms"]["shared"]["models"]["tenant-b"]["goodput_frac"]
+    assert b_budget >= 0.95, out["arms"]["budgets"]
+    assert b_shared < 0.8 * b_budget, out["arms"]["shared"]
+    arm = out["brownout_arm"]
+    assert arm["classes"]["interactive"]["goodput_frac"] >= 0.95, arm
+    assert arm["peak_stage"] >= 3, arm
+    assert arm["burn_final"] < 1.0, arm
+    assert arm["flap_free"] is True, arm
+    # The flood was actually shed by the ladder, not absorbed.
+    assert arm["classes"]["best-effort"]["shed_429"] > 0, arm
 
 
 @pytest.mark.slow

@@ -1,0 +1,408 @@
+"""Brownout ladder: hysteresis state machine on a fake clock, the SWR
+cache bound, and the class-shedding behavior end-to-end over a real
+gateway + model-server pair (stub engine, device-free).
+
+The controller's contract under test: stage s enters only at
+burn >= enter*s, leaves only below exit*s, moves at most ONE stage per
+evaluate(), any two transitions are dwell-separated, and interactive is
+never shed.  Stage 2's stale serves must never outlive TTL + SWR.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from kubernetes_deep_learning_tpu.serving.admission.brownout import (
+    BrownoutController,
+)
+from kubernetes_deep_learning_tpu.serving.cache import ResponseCache
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+
+class _FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class _StubSlo:
+    """The one surface BrownoutController reads: enabled + model_windows()."""
+
+    enabled = True
+
+    def __init__(self, burn: float = 0.0, window: str = "5m"):
+        self.burn = burn
+        self.window = window
+
+    def model_windows(self):
+        return {"m": {self.window: {"burn_rate": self.burn}}}
+
+
+def _controller(burn=0.0, dwell_s=5.0, enter=2.0, exit_=1.0, registry=None):
+    slo = _StubSlo(burn)
+    clock = _FakeClock()
+    ctl = BrownoutController(
+        slo, registry=registry, enabled=True, burn_enter=enter,
+        burn_exit=exit_, dwell_s=dwell_s, clock=clock,
+    )
+    return ctl, slo, clock
+
+
+# --- hysteresis state machine on a fake clock ------------------------------
+
+
+def test_disabled_without_slo_engine():
+    ctl = BrownoutController(None, enabled=True, clock=_FakeClock())
+    assert not ctl.enabled
+    assert ctl.evaluate() == 0
+
+    class Dead:
+        enabled = False
+
+    ctl = BrownoutController(Dead(), enabled=True, clock=_FakeClock())
+    assert not ctl.enabled and ctl.max_burn() == 0.0
+
+
+def test_monotone_walk_up_and_down_every_boundary():
+    reg = metrics_lib.Registry()
+    ctl, slo, clock = _controller(burn=10.0, dwell_s=5.0, registry=reg)
+    # Burn 10 clears every enter boundary (2/4/6/8) at once, yet the
+    # ladder climbs exactly one stage per dwell-separated evaluation.
+    stages = []
+    for _ in range(6):
+        clock.t += 6.0
+        stages.append(ctl.evaluate())
+    assert stages == [1, 2, 3, 4, 4, 4]
+    # Full recovery: burn 0 is below exit*s for every s -- one stage down
+    # per evaluation, never a cliff back to 0.
+    slo.burn = 0.0
+    down = []
+    for _ in range(6):
+        clock.t += 6.0
+        down.append(ctl.evaluate())
+    assert down == [3, 2, 1, 0, 0, 0]
+    # The centrally-minted series agree: gauge back at 0, each boundary
+    # crossed exactly once in each direction, no flap pairs beyond that.
+    text = reg.render()
+    assert "kdlt_brownout_stage 0" in text
+    for s in (1, 2, 3, 4):
+        for d in ("up", "down"):
+            assert (
+                f'kdlt_brownout_transitions_total{{stage="{s}",'
+                f'direction="{d}"}} 1'
+            ) in text
+
+
+def test_dwell_separates_transitions():
+    ctl, slo, clock = _controller(burn=100.0, dwell_s=10.0)
+    # The FIRST transition needs no prior dwell (an incident should not
+    # wait out a timer that never started).
+    clock.t = 0.5
+    assert ctl.evaluate() == 1
+    # Repeated evaluations inside the dwell hold the stage no matter how
+    # hard the signal pushes.
+    for dt in (1.0, 3.0, 5.0):
+        clock.t = 0.5 + dt
+        assert ctl.evaluate() == 1
+    clock.t = 11.0  # dwell elapsed -> exactly one more step
+    assert ctl.evaluate() == 2
+    assert ctl.evaluate() == 2  # and immediately re-held
+
+
+def test_dead_band_holds_stage_without_flapping():
+    ctl, slo, clock = _controller(burn=10.0, dwell_s=0.0, enter=2.0, exit_=1.0)
+    assert [ctl.evaluate() for _ in range(2)] == [1, 2]
+    # Burn in [exit*2, enter*3) = [2, 6): too low to enter 3, too high to
+    # leave 2 -- the hysteresis dead band where stage 2 holds steady.
+    for burn in (2.0, 3.5, 5.9):
+        slo.burn = burn
+        for _ in range(5):
+            assert ctl.evaluate() == 2
+    assert len(ctl.transitions) == 2  # nothing beyond the two climbs
+
+
+def test_noisy_burn_cannot_flap_faster_than_dwell():
+    ctl, slo, clock = _controller(burn=0.0, dwell_s=10.0)
+    # A signal oscillating across the stage-1 boundary every second: with
+    # a 10 s dwell the ladder may move at most once per 10 s.
+    for i in range(100):
+        clock.t = float(i)
+        slo.burn = 100.0 if i % 2 == 0 else 0.0
+        ctl.evaluate()
+    times = [tr["t"] for tr in ctl.transitions]
+    assert all(b - a >= 10.0 for a, b in zip(times, times[1:])), times
+    assert all(abs(tr["to"] - tr["from"]) == 1 for tr in ctl.transitions)
+
+
+def test_misconfigured_exit_clamps_below_enter():
+    # exit >= enter would remove the dead band entirely (a thermostat);
+    # the controller degrades it to enter/2 instead of flapping.
+    ctl, _, _ = _controller(enter=2.0, exit_=3.0)
+    assert ctl.burn_exit == 1.0
+    ctl, _, _ = _controller(enter=2.0, exit_=0.0)
+    assert ctl.burn_exit == 1.0
+
+
+def test_stage_gates_and_shed_classes():
+    ctl, slo, clock = _controller(burn=100.0, dwell_s=0.0)
+    expect = {
+        0: (False, False, set()),
+        1: (True, False, set()),
+        2: (True, True, set()),
+        3: (True, True, {"best-effort"}),
+        4: (True, True, {"best-effort", "batch"}),
+    }
+    for stage in range(5):
+        hedge_off, stale, shed = expect[stage]
+        assert ctl.stage == stage
+        assert ctl.hedging_disabled is hedge_off
+        assert ctl.serve_stale is stale
+        for cls in ("interactive", "batch", "best-effort"):
+            assert ctl.sheds(cls) is (cls in shed), (stage, cls)
+        if stage < 4:
+            ctl.evaluate()
+    # Interactive is never shed, by construction, at any stage.
+    assert not ctl.sheds("interactive")
+
+
+# --- stage 2's staleness bound: TTL + SWR, never more ----------------------
+
+
+def test_swr_serves_within_window_and_never_past_it():
+    cache = ResponseCache(ttl_s=0.15, max_mb=1.0, neg_ttl_s=0.0, swr_s=0.3)
+    cache.put("k", b"body", "application/json", "m", "h")
+    # Fresh: ordinary hit, not stale.
+    assert cache.lookup_swr("k", stale_ok=False) == (
+        200, b"body", "application/json", False,
+    )
+    time.sleep(0.2)  # past TTL, inside the SWR window
+    # Without stale_ok (stage < 2) an in-window entry answers None but is
+    # NOT evicted -- a brownout arriving later can still use it.
+    assert cache.lookup_swr("k", stale_ok=False) is None
+    got = cache.lookup_swr("k", stale_ok=True)
+    assert got == (200, b"body", "application/json", True)
+    assert cache.stale_hits == 1
+    time.sleep(0.35)  # past TTL + SWR: gone even for a desperate caller
+    assert cache.lookup_swr("k", stale_ok=True) is None
+    assert cache.stale_hits == 1
+
+
+def test_negative_entries_never_get_swr():
+    cache = ResponseCache(ttl_s=60.0, max_mb=1.0, neg_ttl_s=0.1, swr_s=30.0)
+    cache.put("bad", b"nope", "application/json", "m", "h", status=404)
+    assert cache.lookup_swr("bad", stale_ok=True)[0] == 404
+    time.sleep(0.15)  # neg TTL expired: a replayed 404 is pure harm
+    assert cache.lookup_swr("bad", stale_ok=True) is None
+
+
+# --- end-to-end over a real gateway + model-server -------------------------
+
+
+def _two_tier_stack(tmp_path, **gw_kw):
+    from functools import partial
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    spec = register_spec(ModelSpec(
+        name="brownout-e2e", family="xception",
+        input_shape=(32, 32, 3), labels=("a", "b", "c"),
+    ))
+    root = tmp_path / "models"
+    art.save_artifact(
+        art.version_dir(str(root), spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        str(root), port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=lambda a, **kw: StubEngine(a, **kw),
+    )
+    server.warmup()
+    server.start()
+    rng = np.random.default_rng(0)
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(tmp_path / "img.png")
+    httpd = HTTPServer(
+        ("127.0.0.1", 0),
+        partial(SimpleHTTPRequestHandler, directory=str(tmp_path)),
+    )
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, host="127.0.0.1", **gw_kw,
+    )
+    gw.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/img.png"
+    return server, httpd, gw, url
+
+
+def test_brownout_sheds_classes_and_serves_stale_end_to_end(tmp_path):
+    import requests
+
+    from kubernetes_deep_learning_tpu.serving import protocol
+
+    # brownout=False keeps the gateway's own evaluate() daemon off: the
+    # test drives the replacement controller's ladder by hand, one stage
+    # at a time, so each stage's observable behavior can be pinned.
+    server, httpd, gw, url = _two_tier_stack(
+        tmp_path, cache=True, cache_ttl_s=0.3, cache_swr_s=30.0,
+        brownout=False,
+    )
+    ctl, slo, clock = _controller(burn=100.0, dwell_s=0.0)
+    gw.brownout = ctl
+    base = f"http://127.0.0.1:{gw.port}"
+
+    def predict(priority=None):
+        headers = {}
+        if priority is not None:
+            headers[protocol.PRIORITY_HEADER] = priority
+        return requests.post(
+            f"{base}/predict", json={"url": url}, headers=headers, timeout=30
+        )
+
+    try:
+        # Healthy (stage 0): the first request fills the cache.
+        r = predict()
+        assert r.status_code == 200, r.text
+        assert r.headers.get(protocol.CACHE_STATUS_HEADER) == "miss"
+
+        ctl.evaluate(), ctl.evaluate()  # -> stage 2
+        assert ctl.stage == 2
+        time.sleep(0.4)  # TTL-expire the entry; SWR keeps it resident
+        r = predict()
+        assert r.status_code == 200
+        assert r.headers.get(protocol.CACHE_STATUS_HEADER) == "stale"
+
+        ctl.evaluate()  # -> stage 3: best-effort shed, batch still served
+        assert ctl.stage == 3
+        r = predict(priority="best-effort")
+        assert r.status_code == 429
+        assert r.json()["shed_reason"] == "brownout"
+        assert "Retry-After" in r.headers
+        assert predict(priority="batch").status_code == 200
+
+        ctl.evaluate()  # -> stage 4: batch shed too; interactive never
+        assert ctl.stage == 4
+        r = predict(priority="batch")
+        assert r.status_code == 429 and r.json()["shed_reason"] == "brownout"
+        assert predict(priority="interactive").status_code == 200
+
+        # The operator surface agrees with what the wire just showed.
+        dbg = requests.get(f"{base}/debug/brownout", timeout=5).json()
+        assert dbg["stage"] == 4
+        assert dbg["actions"] == [
+            "hedging disabled", "stale cache serves",
+            "shed best-effort", "shed batch",
+        ]
+        assert dbg["classes"]["best-effort"]["shed"] >= 1
+        assert dbg["classes"]["batch"]["shed"] >= 1
+        assert dbg["classes"]["interactive"]["shed"] == 0
+        metrics = requests.get(f"{base}/metrics", timeout=5).text
+        assert (
+            'kdlt_admission_class_shed_total{class="best-effort",'
+            'tier="gateway"}' in metrics
+            or 'class="best-effort"' in metrics
+        )
+        assert 'shed_reason="brownout"' in metrics
+    finally:
+        gw.shutdown()
+        server.shutdown()
+        httpd.shutdown()
+
+
+def test_budget_isolates_tenant_from_noisy_neighbor(tmp_path, monkeypatch):
+    """Per-model budgets at the model tier: tenant A floods all slots and
+    queues deep over-share; tenant B's single request must still be
+    granted ahead of A's over-share waiters (work-conserving borrowing,
+    borrowed capacity handed back first)."""
+    import requests
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    monkeypatch.setenv("KDLT_ADMISSION_MAX_CONCURRENCY", "2")
+    monkeypatch.setenv("KDLT_ADMISSION_INITIAL_CONCURRENCY", "2")
+    monkeypatch.setenv("KDLT_ADMIT_BUDGETS", "nb-a=1,nb-b=1")
+    root = tmp_path / "models"
+    specs = {}
+    for name in ("nb-a", "nb-b"):
+        spec = register_spec(ModelSpec(
+            name=name, family="xception",
+            input_shape=(32, 32, 3), labels=("a", "b", "c"),
+        ))
+        art.save_artifact(
+            art.version_dir(str(root), name, 1), spec, {"params": {}}, None, {}
+        )
+        specs[name] = spec
+    server = ModelServer(
+        str(root), port=0, buckets=(1,), max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=lambda a, **kw: StubEngine(
+            a, device_ms_per_batch=200.0, **kw
+        ),
+    )
+    server.warmup()
+    server.start()
+    try:
+        limiter = server.admission.limiter
+        assert limiter is not None
+        assert limiter.budgets == {"nb-a": 1.0, "nb-b": 1.0}
+
+        done: dict = {}
+
+        def hit(tag, model):
+            img = np.zeros((1, 32, 32, 3), np.uint8)
+            r = requests.post(
+                f"http://127.0.0.1:{server.port}/v1/models/{model}:predict",
+                data=protocol.encode_predict_request(img),
+                headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+                timeout=30,
+            )
+            done[tag] = (r.status_code, time.monotonic())
+
+        # Tenant A floods: 6 requests against 2 slots, 200 ms serial
+        # each -- both slots taken (one borrowed from B) and the queue
+        # holds A waiters deep over A's 1-slot share.
+        flood = [
+            threading.Thread(target=hit, args=(f"a{i}", "nb-a"))
+            for i in range(6)
+        ]
+        for t in flood:
+            t.start()
+        for _ in range(200):
+            if server.admission.inflight >= 2:
+                break
+            time.sleep(0.01)
+        assert server.admission.inflight >= 2
+        time.sleep(0.05)  # let the remaining A requests enqueue behind
+        # Mid-flood the debug surface shows A's budget: one active model
+        # owns the whole limit until B shows up.
+        assert limiter.shares().get("nb-a") == limiter.limit
+        tb = threading.Thread(target=hit, args=("b", "nb-b"))
+        tb.start()
+        for t in [*flood, tb]:
+            t.join(timeout=30)
+
+        assert done["b"][0] == 200, done
+        # B arrived LAST yet finished before A's flood drained: the next
+        # free slot went to the under-share owner, not A's earlier
+        # waiters.  Without budgets FIFO order would finish B last.
+        a_finishes = [done[f"a{i}"][1] for i in range(6)
+                      if done[f"a{i}"][0] == 200]
+        assert a_finishes, done
+        assert done["b"][1] < max(a_finishes), done
+    finally:
+        server.shutdown()

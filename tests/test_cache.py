@@ -193,7 +193,7 @@ def _stub_gateway(monkeypatch=None, upstream_delay_s=0.0, **kw):
         return np.zeros((8, 8, 3), np.uint8)
 
     def fake_predict_batch(images, request_id="", deadline=None, trace=None,
-                           model=None):
+                           model=None, priority=None):
         calls["n"] += 1
         if upstream_delay_s:
             time.sleep(upstream_delay_s)
@@ -365,7 +365,8 @@ def test_upstream_error_is_shared_with_followers_but_never_cached():
     fail = {"on": True}
     real_predict = gw._predict_batch
 
-    def flaky(images, request_id="", deadline=None, trace=None, model=None):
+    def flaky(images, request_id="", deadline=None, trace=None, model=None,
+              priority=None):
         if fail["on"]:
             calls["n"] += 1
             from kubernetes_deep_learning_tpu.serving.gateway import (
@@ -373,7 +374,8 @@ def test_upstream_error_is_shared_with_followers_but_never_cached():
             )
 
             raise UpstreamError("injected model tier failure", 502)
-        return real_predict(images, request_id, deadline, trace, model)
+        return real_predict(images, request_id, deadline, trace, model,
+                            priority=priority)
 
     gw._predict_batch = flaky
     try:
@@ -401,7 +403,7 @@ def test_hot_reload_with_changed_bytes_evicts_cached_entries():
     real_predict = gw._predict_batch
 
     def versioned(images, request_id="", deadline=None, trace=None,
-                  model=None):
+                  model=None, priority=None):
         calls["n"] += 1
         gw.cache.note_artifact_hash(model or gw.model, current["hash"])
         return [np.arange(3, dtype=np.float32)], ["a", "b", "c"]

@@ -1,0 +1,93 @@
+"""tools/check_env.py wired into tier-1: every KDLT_* knob the tree
+reads must be documented in GUIDE.md, deploy manifest keys must exist in
+code, and the compose/k8s mirrors of each tier must agree -- plus unit
+coverage that the lint's own pieces catch what they claim to."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+import check_env  # noqa: E402
+
+
+def test_production_tree_is_clean(capsys):
+    assert check_env.main() == 0, capsys.readouterr().out
+
+
+def test_env_literals_whole_string_only():
+    # Whole-string KDLT_* literals are env names; WSGI keys and doc
+    # fragments embedding the pattern are not.
+    found = check_env.env_literals(
+        'A = "KDLT_FOO"\n'
+        'B = os.environ.get("KDLT_BAR_S", "1")\n'
+        'W = "HTTP_X_KDLT_PRIORITY"\n'   # WSGI key, not an env var
+        'D = "see $KDLT_DOCS for why"\n',  # prose, not a name
+        "fake.py",
+    )
+    assert set(found) == {"KDLT_FOO", "KDLT_BAR_S"}
+    assert found["KDLT_FOO"] == 1
+
+
+def test_compose_env_parses_map_and_list_forms():
+    doc = {"services": {
+        "a": {"environment": {"KDLT_X": 1, "OTHER": "y"}},
+        "b": {"environment": ["KDLT_Y=2", "PATH=/x"]},
+    }}
+    assert check_env.compose_env(doc, "a") == {"KDLT_X": "1"}
+    assert check_env.compose_env(doc, "b") == {"KDLT_Y": "2"}
+    assert check_env.compose_env(doc, "missing") == {}
+
+
+def test_k8s_env_walks_all_containers():
+    doc = {"spec": {"template": {"spec": {"containers": [
+        {"env": [{"name": "KDLT_X", "value": "1"},
+                 {"name": "POD_IP", "value": "x"}]},
+        {"env": [{"name": "KDLT_Y", "value": "2"}]},
+    ]}}}}
+    assert check_env.k8s_env(doc) == {"KDLT_X": "1", "KDLT_Y": "2"}
+
+
+def test_new_isolation_knobs_are_wired_everywhere():
+    # The ISSUE-12 knobs must be present (and equal) in both deploy
+    # mirrors of the tier that owns them -- presence here, agreement via
+    # main() above.
+    import yaml
+
+    with open(os.path.join(check_env.REPO, check_env.COMPOSE)) as f:
+        compose = yaml.safe_load(f)
+    with open(os.path.join(check_env.REPO, check_env.K8S_GATEWAY)) as f:
+        k8s_gw = check_env.k8s_env(yaml.safe_load(f))
+    with open(os.path.join(check_env.REPO, check_env.K8S_MODEL)) as f:
+        k8s_model = check_env.k8s_env(yaml.safe_load(f))
+    gw = check_env.compose_env(compose, "gateway")
+    for knob in ("KDLT_ADMIT_BUDGETS", "KDLT_BROWNOUT",
+                 "KDLT_BROWNOUT_BURN_ENTER", "KDLT_BROWNOUT_BURN_EXIT",
+                 "KDLT_CACHE_SWR_S"):
+        assert knob in gw, knob
+        assert knob in k8s_gw, knob
+        assert gw[knob] == k8s_gw[knob], knob
+    for svc in ("model-server", "model-server-b"):
+        env = check_env.compose_env(compose, svc)
+        assert env["KDLT_ADMIT_BUDGETS"] == k8s_model["KDLT_ADMIT_BUDGETS"]
+
+
+def test_every_knob_in_guide_is_spelled_in_full(tmp_path):
+    # The failure mode the lint exists for: a knob read by code but
+    # absent from GUIDE.md (e.g. hidden inside a brace-expansion like
+    # KDLT_X_{MIN,MAX}) must be flagged.  Simulate by checking the
+    # production scan's names against a guide stripped of one of them.
+    code_envs = {}
+    for path in check_env.iter_production_files():
+        with open(path) as f:
+            code_envs.update(check_env.env_literals(f.read(), path))
+    assert "KDLT_ADMISSION_MAX_CONCURRENCY" in code_envs
+    assert "KDLT_BROWNOUT_DWELL_S" in code_envs
+    with open(os.path.join(check_env.REPO, check_env.GUIDE)) as f:
+        guide = f.read()
+    for name in code_envs:
+        assert name in guide, f"{name} undocumented in GUIDE.md"

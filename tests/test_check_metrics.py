@@ -209,3 +209,19 @@ def test_lint_flags_scheme_label_outside_central():
     assert check_metrics.lint_source(
         src, os.path.join("kubernetes_deep_learning_tpu", "utils", "metrics.py")
     ) == []
+
+
+def test_lint_flags_brownout_series_and_labels_outside_central():
+    # Brownout ladder series (ISSUE 12): kdlt_brownout_* mints and the
+    # bounded stage/direction labels are confined to utils/metrics.py.
+    src = 'reg.gauge("kdlt_brownout_stage", "rogue mint")\n'
+    (v,) = check_metrics.lint_source(src, "fake.py")
+    assert "kdlt_brownout_" in v and "central" in v
+    assert check_metrics.lint_source(src, _METRICS_PATH) == []
+    (v,) = check_metrics.lint_source(
+        'reg.with_labels(stage="3", direction="up")\n', "fake.py"
+    )
+    assert "direction" in v and "stage" in v and "central" in v
+    assert check_metrics.lint_source(
+        'reg.with_labels(stage="3", direction="up")\n', _METRICS_PATH
+    ) == []

@@ -515,6 +515,83 @@ def test_quant_envs_agree_across_k8s_and_compose():
         del os.environ[QUANT_SCHEME_ENV]
 
 
+def test_isolation_and_brownout_envs_agree_across_k8s_and_compose():
+    """The tenant-isolation wiring (ISSUE 12): per-model admission budgets
+    on EVERY tier copy (a replica pair disagreeing on partitioning would
+    shed different tenants under the same overload), and the brownout
+    ladder + SWR window on both gateway deploys, with values the code's
+    own resolvers accept."""
+    from kubernetes_deep_learning_tpu.serving.admission.brownout import (
+        BROWNOUT_ENV,
+        BURN_ENTER_ENV,
+        BURN_EXIT_ENV,
+        brownout_enabled,
+    )
+    from kubernetes_deep_learning_tpu.serving.admission.limiter import (
+        BUDGETS_ENV,
+        env_budgets,
+    )
+    from kubernetes_deep_learning_tpu.serving.cache import SWR_ENV, TTL_ENV
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    (gw_container,) = gw_dep["spec"]["template"]["spec"]["containers"]
+    k8s_gw = {e["name"]: str(e.get("value", "")) for e in gw_container["env"]}
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (model_container,) = model_dep["spec"]["template"]["spec"]["containers"]
+    k8s_model = {
+        e["name"]: str(e.get("value", "")) for e in model_container["env"]
+    }
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+
+    def compose_env(svc):
+        return {
+            k: str(v)
+            for k, v in compose["services"][svc]["environment"].items()
+        }
+
+    # Budgets: present and agreeing on every copy of every tier.
+    budget_copies = {
+        "k8s/gateway": k8s_gw.get(BUDGETS_ENV),
+        "k8s/model-server": k8s_model.get(BUDGETS_ENV),
+        "compose/gateway": compose_env("gateway").get(BUDGETS_ENV),
+        "compose/model-server": compose_env("model-server").get(BUDGETS_ENV),
+        "compose/model-server-b": compose_env("model-server-b").get(BUDGETS_ENV),
+    }
+    assert all(v is not None for v in budget_copies.values()), budget_copies
+    assert len(set(budget_copies.values())) == 1, budget_copies
+    # ... and the shipped value ENABLES partitioning through the code's
+    # own resolver (None would be the legacy shared limiter).
+    os.environ[BUDGETS_ENV] = k8s_model[BUDGETS_ENV]
+    try:
+        assert env_budgets() is not None, "deploys ship the legacy limiter"
+    finally:
+        del os.environ[BUDGETS_ENV]
+
+    # Brownout ladder + SWR: both gateway deploys, agreeing.
+    compose_gw = compose_env("gateway")
+    for var in (BROWNOUT_ENV, BURN_ENTER_ENV, BURN_EXIT_ENV, SWR_ENV):
+        assert var in k8s_gw, f"k8s gateway must set {var}"
+        assert var in compose_gw, f"compose gateway must set {var}"
+        assert k8s_gw[var] == compose_gw[var], (
+            f"{var} disagrees: k8s={k8s_gw[var]!r} compose={compose_gw[var]!r}"
+        )
+    os.environ[BROWNOUT_ENV] = k8s_gw[BROWNOUT_ENV]
+    try:
+        assert brownout_enabled() is True, "deploys ship the kill switch"
+    finally:
+        del os.environ[BROWNOUT_ENV]
+    enter = float(k8s_gw[BURN_ENTER_ENV])
+    exit_ = float(k8s_gw[BURN_EXIT_ENV])
+    assert 0.0 < exit_ < enter, (
+        "hysteresis requires exit strictly inside (0, enter)"
+    )
+    # The SWR window only matters under brownout; it must be positive and
+    # it bounds worst-case staleness to TTL + SWR, so keep it sane vs TTL.
+    assert float(k8s_gw[SWR_ENV]) > 0, "SWR wired off"
+    assert float(k8s_gw[SWR_ENV]) <= 10 * float(k8s_gw[TTL_ENV])
+
+
 def test_gateway_negative_cache_ttl_wired():
     """Negative caching (ROADMAP cache follow-on #1): both gateway deploys
     carry KDLT_CACHE_NEG_TTL_S, agreeing, positive (the feature is ON in

@@ -52,15 +52,21 @@ METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # Labels whose value sets are bounded by construction inside utils/metrics.py
 # (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
 # the trace retention classes; reason: the cache eviction reasons; scheme:
-# the quantization scheme list; source: the warmup provenance pair) --
+# the quantization scheme list; source: the warmup provenance pair;
+# stage/direction: the brownout ladder's four stages and two directions) --
 # attaching them anywhere else escapes the bound.
-CENTRAL_LABELS = {"model", "window", "class", "reason", "scheme", "source"}
+CENTRAL_LABELS = {
+    "model", "window", "class", "reason", "scheme", "source",
+    "stage", "direction",
+}
 # Series prefixes whose minting is confined to utils/metrics.py even beyond
 # the general helper conventions (the SLO gauge matrix, the response
 # cache's series, the quantization scheme/gate series, and the dynamic-
 # membership pool series: all carry bounded labels a stray mint would
 # escape).
-CENTRAL_PREFIXES = ("kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_")
+CENTRAL_PREFIXES = (
+    "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
+)
 # Exact series names likewise confined to utils/metrics.py: these live
 # under prefixes too broad to confine wholesale (kdlt_engine_* is minted
 # per-engine in runtime/engine.py) but carry a bounded label.
@@ -202,9 +208,9 @@ def lint_source(src: str, rel: str) -> list[str]:
                 violations.append(
                     f"{rel}:{node.lineno}: {head!r} minted outside "
                     "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
-                    "kdlt_pool_* series (and kdlt_engine_warm_source) are "
-                    "minted only by the central helpers (bounded label sets "
-                    "by construction)"
+                    "kdlt_pool_*/kdlt_brownout_* series (and "
+                    "kdlt_engine_warm_source) are minted only by the central "
+                    "helpers (bounded label sets by construction)"
                 )
     return violations
 
