@@ -2642,6 +2642,439 @@ def bench_chaos_ab(duration_s=6.0, device_ms=30.0, deadline_ms=2000.0,
     return out, 0 if ok else 1
 
 
+def bench_incident_ab(duration_s=6.0, device_ms=40.0, deadline_ms=1500.0,
+                      rate_rps=24.0, seed=0):
+    """Incident flight-recorder A/B (GUIDE 10m): flapping failures -> ONE
+    bundle each, captured fast, merged at the gateway, and free.
+
+    Three parts, all device-free (stub engines):
+
+    1. STALL ARM -- a real gateway fronts two stub replicas (recorders ON,
+       each tier with its own bundle dir); mid-run the victim's dispatcher
+       declares a terminal stall (the engine watchdog's own action), then
+       the victim is hammered with several more requests, each of which
+       records another dispatch.stall event -- a flapping condition.  The
+       victim must capture EXACTLY ONE dispatch-stall bundle (dedup window
+       eats the re-fires, counted in kdlt_incident_suppressed_total), its
+       timeline must be monotonic-ordered, it must pin the causal trace of
+       the firing request, and the capture must land in < 2 s.  The
+       gateway observes X-Kdlt-Stalled, flips the replica unhealthy, and
+       captures its own replica-unhealthy bundle; its /debug/incidents
+       must list the victim's bundle (fetchable by id THROUGH the
+       gateway) and group the two tiers' captures into one causal window.
+
+    2. BROWNOUT ARM -- a best-effort flood through a real gateway with a
+       compressed SLO window + fast dwell makes the brownout ladder climb
+       several stages (several brownout.enter events); hysteresis +
+       dedup must yield EXACTLY ONE brownout bundle carrying the slo +
+       brownout snapshots.
+
+    3. OVERHEAD -- closed-loop throughput against a stub model tier with
+       the recorder ON vs OFF (interleaved rounds, best counts): the
+       recorder hooks only failure edges, so ON must hold >= 0.98x OFF.
+
+    Returns (json_dict, rc); rc=0 iff all three parts' gates hold.
+    """
+    import re
+    import tempfile
+    import threading
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    import requests
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import protocol
+    from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    spec = register_spec(
+        ModelSpec(
+            name="incident-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    deadline_s = deadline_ms / 1e3
+    rng = np.random.default_rng(seed)
+    img_dir = tempfile.mkdtemp(prefix="kdlt-incident-img-")
+    Image.fromarray(
+        rng.integers(0, 256, size=(48, 48, 3), dtype=np.uint8)
+    ).save(os.path.join(img_dir, "img.png"))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    img_url = f"http://127.0.0.1:{img_httpd.server_address[1]}/img.png"
+    log(
+        f"incident A/B: stall + brownout + overhead arms, stub tier "
+        f"{device_ms:g}ms/batch, {rate_rps:g} req/s, deadline "
+        f"{deadline_ms:.0f}ms, seed {seed}"
+    )
+
+    def start_replica(stall_capable=False, incident=True, stub_ms=device_ms):
+        root = tempfile.mkdtemp(prefix="kdlt-incident-ms-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        server = ModelServer(
+            root, port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+            engine_factory=lambda a, **kw: StubEngine(
+                a, device_ms_per_batch=stub_ms,
+                async_device=stall_capable, **kw
+            ),
+            incident=incident,
+            incident_dir=tempfile.mkdtemp(prefix="kdlt-incident-dir-"),
+        )
+        server.warmup()
+        server.start()
+        return server
+
+    def metric(rendered: str, name: str, **labels) -> float:
+        sel = "".join(
+            rf'(?=[^}}]*{k}="{v}")' for k, v in labels.items()
+        )
+        pat = rf"^{name}\{{{sel}[^}}]*\}} (\S+)$" if labels else (
+            rf"^{name}(?:\{{[^}}]*\}})? (\S+)$"
+        )
+        m = re.search(pat, rendered, re.M)
+        return float(m.group(1)) if m else 0.0
+
+    session = requests.Session()
+    session.mount("http://", requests.adapters.HTTPAdapter(
+        pool_connections=4, pool_maxsize=256,
+    ))
+    failures: list[str] = []
+
+    def gate(ok: bool, why: str) -> bool:
+        if not ok:
+            failures.append(why)
+        return ok
+
+    # ---- Part 1: the stall arm -------------------------------------------
+    victim = start_replica(stall_capable=True)
+    survivor = start_replica()
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{victim.port},127.0.0.1:{survivor.port}",
+        model=spec.name, port=0, host="127.0.0.1",
+        probe_interval_s=0.3, cache=False,
+        incident=True,
+        incident_dir=tempfile.mkdtemp(prefix="kdlt-incident-gw-"),
+    )
+    gw.start()
+    gw.spec
+
+    def fire_gw(results: list) -> None:
+        try:
+            r = session.post(
+                f"http://127.0.0.1:{gw.port}/predict",
+                json={"url": img_url},
+                headers={DEADLINE_HEADER: f"{deadline_ms:.1f}"},
+                timeout=deadline_s + 5.0,
+            )
+            results.append(r.status_code)
+        except Exception:
+            results.append(-1)
+
+    pre_results: list = []
+    n_pre = max(6, int(rate_rps * min(2.0, duration_s / 3.0)))
+    pre_threads = [
+        threading.Thread(target=fire_gw, args=(pre_results,), daemon=True)
+        for _ in range(n_pre)
+    ]
+    for t in pre_threads:
+        t.start()
+        time.sleep(1.0 / rate_rps)
+    for t in pre_threads:
+        t.join(timeout=deadline_s + 10.0)
+
+    # The watchdog's own action, invoked directly: from this instant the
+    # victim answers fast 503s carrying X-Kdlt-Stalled and fails /healthz.
+    victim.scheduler.dispatcher.declare_stall()
+    # Flap it: several more requests hit the stalled dispatcher DIRECTLY,
+    # each recording another dispatch.stall event inside the dedup window.
+    stall_payload = protocol.encode_predict_request(
+        rng.integers(0, 256, size=(1, 32, 32, 3), dtype=np.uint8)
+    )
+    stall_statuses = []
+    for _ in range(5):
+        r = session.post(
+            f"http://127.0.0.1:{victim.port}/v1/models/{spec.name}:predict",
+            data=stall_payload,
+            headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+            timeout=10.0,
+        )
+        stall_statuses.append(r.status_code)
+    # ... and a few through the gateway, so it observes the stall header
+    # and flips the replica unhealthy (its own replica-unhealthy trigger).
+    post_results: list = []
+    post_threads = [
+        threading.Thread(target=fire_gw, args=(post_results,), daemon=True)
+        for _ in range(6)
+    ]
+    for t in post_threads:
+        t.start()
+        time.sleep(1.0 / rate_rps)
+    for t in post_threads:
+        t.join(timeout=deadline_s + 10.0)
+
+    victim.recorder.wait_idle(timeout=10.0)
+    gw.recorder.wait_idle(timeout=10.0)
+
+    stall_events = [
+        e for e in victim.recorder.events() if e["kind"] == "dispatch.stall"
+    ]
+    stall_bundles = [
+        e for e in victim.recorder.index() if e["trigger"] == "dispatch-stall"
+    ]
+    gate(len(stall_statuses) == 5 and all(s == 503 for s in stall_statuses),
+         f"stalled victim answered {stall_statuses}, expected five 503s")
+    gate(len(stall_events) >= 2,
+         f"only {len(stall_events)} dispatch.stall events; the flap never "
+         "flapped")
+    gate(len(stall_bundles) == 1,
+         f"{len(stall_bundles)} dispatch-stall bundles captured, expected "
+         "exactly 1 (dedup)")
+    victim_metrics = victim.registry.render()
+    suppressed = metric(
+        victim_metrics, "kdlt_incident_suppressed_total",
+        trigger="dispatch-stall",
+    )
+    gate(suppressed >= 1,
+         f"suppressed counter {suppressed}; dedup left no evidence")
+    stall_arm: dict = {
+        "stall_events": len(stall_events),
+        "bundles": len(stall_bundles),
+        "suppressed": suppressed,
+    }
+    if stall_bundles:
+        bundle = victim.recorder.get(stall_bundles[0]["id"])
+        mono = [e["m"] for e in bundle["events"]]
+        gate(mono == sorted(mono), "stall bundle timeline is out of order")
+        fired_rid = (bundle["event"] or {}).get("rid")
+        gate(bool(fired_rid) and fired_rid in (bundle.get("traces") or {}),
+             f"stall bundle does not pin the causal trace (rid={fired_rid})")
+        gate(bundle["capture_latency_s"] < 2.0,
+             f"capture latency {bundle['capture_latency_s']}s >= 2s")
+        stall_arm.update({
+            "id": bundle["id"],
+            "events": len(bundle["events"]),
+            "traces": sorted((bundle.get("traces") or {}).keys()),
+            "capture_latency_s": bundle["capture_latency_s"],
+        })
+        # The gateway must serve the victim's bundle BY ID (merge path).
+        r = session.get(
+            f"http://127.0.0.1:{gw.port}/debug/incidents/{bundle['id']}",
+            timeout=5.0,
+        )
+        gate(r.status_code == 200 and r.json().get("id") == bundle["id"],
+             f"gateway could not serve the victim's bundle ({r.status_code})")
+    merged = session.get(
+        f"http://127.0.0.1:{gw.port}/debug/incidents", timeout=5.0
+    ).json()
+    windows = merged.get("windows") or []
+    cross_tier = [
+        w for w in windows
+        if len(w.get("incidents", [])) >= 2
+        and len({i.get("origin") for i in w["incidents"]}) >= 2
+    ]
+    gate(bool(cross_tier),
+         "no merged causal window spans both tiers' captures")
+    stall_arm["windows"] = len(windows)
+    stall_arm["cross_tier_window"] = bool(cross_tier)
+    gw_unhealthy = [
+        e for e in gw.recorder.index() if e["trigger"] == "replica-unhealthy"
+    ]
+    gate(len(gw_unhealthy) >= 1,
+         "gateway never captured a replica-unhealthy bundle")
+    stall_arm["gateway_bundles"] = len(gw.recorder.index())
+    log(
+        f"  stall arm: {len(stall_events)} stall events -> "
+        f"{len(stall_bundles)} bundle(s), {suppressed:.0f} suppressed, "
+        f"capture {stall_arm.get('capture_latency_s', '-')}s, "
+        f"{len(windows)} merged window(s) "
+        f"(cross-tier={'yes' if cross_tier else 'NO'})"
+    )
+    gw.shutdown()
+    victim.shutdown()
+    survivor.shutdown()
+
+    # ---- Part 2: the brownout arm ----------------------------------------
+    window_s = 5.0
+    flood_deadline_ms = 300.0
+    server = start_replica()
+    gw2 = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, host="127.0.0.1", cache=False,
+        slo_windows=(("5m", window_s),),
+        brownout_dwell_s=0.4, brownout_eval_s=0.2,
+        incident=True,
+        incident_dir=tempfile.mkdtemp(prefix="kdlt-incident-gw2-"),
+    )
+    gw2.start()
+    gw2.spec
+
+    def fire_flood(i: int, at: float) -> None:
+        delay = at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            session.post(
+                f"http://127.0.0.1:{gw2.port}/predict",
+                json={"url": f"{img_url}?f={i}"},
+                headers={
+                    DEADLINE_HEADER: f"{flood_deadline_ms:.1f}",
+                    protocol.PRIORITY_HEADER: "best-effort",
+                },
+                timeout=5.0,
+            )
+        except Exception:
+            pass
+
+    flood_s = max(3.0, duration_s / 2.0)
+    flood_rps = 10.0 * rate_rps
+    t_base = time.monotonic() + 0.25
+    flood_threads = [
+        threading.Thread(
+            target=fire_flood, args=(i, t_base + i / flood_rps), daemon=True
+        )
+        for i in range(int(flood_s * flood_rps))
+    ]
+    for t in flood_threads:
+        t.start()
+    for t in flood_threads:
+        t.join(timeout=15.0)
+    deadline = time.monotonic() + 3 * window_s
+    while time.monotonic() < deadline:
+        # Let the ladder exit so the trigger re-arms (hysteresis proof
+        # lives in the suppressed counter from the climb's extra enters).
+        if gw2.brownout.stage == 0:
+            break
+        time.sleep(0.3)
+    gw2.recorder.wait_idle(timeout=10.0)
+    brown_bundles = [
+        e for e in gw2.recorder.index() if e["trigger"] == "brownout"
+    ]
+    brown_events = [
+        e for e in gw2.recorder.events() if e["kind"] == "brownout.enter"
+    ]
+    gate(len(brown_events) >= 1, "brownout never engaged; no enter events")
+    gate(len(brown_bundles) == 1,
+         f"{len(brown_bundles)} brownout bundles, expected exactly 1 "
+         "(hysteresis + dedup)")
+    brown_arm: dict = {
+        "enter_events": len(brown_events),
+        "bundles": len(brown_bundles),
+        "peak_stage": max(
+            (int(e.get("attrs", {}).get("stage", 0)) for e in brown_events),
+            default=0,
+        ),
+    }
+    if brown_bundles:
+        bundle = gw2.recorder.get(brown_bundles[0]["id"])
+        mono = [e["m"] for e in bundle["events"]]
+        gate(mono == sorted(mono), "brownout bundle timeline out of order")
+        gate(bundle["capture_latency_s"] < 2.0,
+             f"brownout capture latency {bundle['capture_latency_s']}s >= 2s")
+        snaps = set((bundle.get("snapshots") or {}).keys())
+        gate({"slo", "brownout", "pool"} <= snaps,
+             f"brownout bundle snapshots incomplete: {sorted(snaps)}")
+        brown_arm.update({
+            "id": bundle["id"],
+            "capture_latency_s": bundle["capture_latency_s"],
+            "snapshots": sorted(snaps),
+        })
+    log(
+        f"  brownout arm: {len(brown_events)} enter event(s), peak stage "
+        f"{brown_arm['peak_stage']} -> {len(brown_bundles)} bundle(s), "
+        f"capture {brown_arm.get('capture_latency_s', '-')}s"
+    )
+    gw2.shutdown()
+    server.shutdown()
+
+    # ---- Part 3: the overhead arm ----------------------------------------
+    # Host-path-bound stub (0 ms device): recorder overhead, if any, shows
+    # at full strength.  Interleaved rounds, best counts (steady-state).
+    on_server = start_replica(incident=True, stub_ms=0.0)
+    off_server = start_replica(incident=False, stub_ms=0.0)
+    thr_payload = protocol.encode_predict_request(
+        rng.integers(0, 256, size=(1, 32, 32, 3), dtype=np.uint8)
+    )
+
+    def throughput(server, seconds=1.2, clients=8) -> float:
+        url = f"http://127.0.0.1:{server.port}/v1/models/{spec.name}:predict"
+        stop_at = time.monotonic() + seconds
+        counts = [0] * clients
+
+        def worker(slot: int) -> None:
+            s = requests.Session()
+            while time.monotonic() < stop_at:
+                r = s.post(
+                    url, data=thr_payload,
+                    headers={"Content-Type": protocol.MSGPACK_CONTENT_TYPE},
+                    timeout=10.0,
+                )
+                if r.status_code == 200:
+                    counts[slot] += 1
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds + 10.0)
+        return sum(counts) / (time.monotonic() - t0)
+
+    best_on = best_off = 0.0
+    for _ in range(2):
+        best_on = max(best_on, throughput(on_server))
+        best_off = max(best_off, throughput(off_server))
+    ratio = best_on / max(best_off, 1e-9)
+    gate(ratio >= 0.98,
+         f"recorder-on throughput {ratio:.3f}x recorder-off (< 0.98)")
+    log(
+        f"  overhead arm: recorder on {best_on:.0f} img/s vs off "
+        f"{best_off:.0f} img/s = {ratio:.3f}x (gate >= 0.98)"
+    )
+    on_server.shutdown()
+    off_server.shutdown()
+    img_httpd.shutdown()
+
+    for why in failures:
+        log(f"  GATE FAILED: {why}")
+    out = {
+        "metric": (
+            "incident flight-recorder A/B (stall flap + brownout flood + "
+            "overhead): exactly-one deduped bundle per trigger with ordered "
+            "causal timeline, gateway cross-tier merge, capture < 2s, "
+            "recorder-on >= 0.98x recorder-off throughput"
+        ),
+        "value": round(ratio, 4),
+        "unit": "recorder-on / recorder-off throughput ratio",
+        "vs_baseline": round(ratio, 2),
+        "stall_arm": stall_arm,
+        "brownout_arm": brown_arm,
+        "overhead": {
+            "on_img_s": round(best_on, 1),
+            "off_img_s": round(best_off, 1),
+            "ratio": round(ratio, 4),
+        },
+        "failures": failures,
+        "seed": seed,
+    }
+    return out, 0 if not failures else 1
+
+
 def bench_churn_ab(duration_s=10.0, device_ms=40.0, deadline_ms=1000.0,
                    rate_rps=32.0, hedge_delay_ms=400.0, probe_interval_s=0.25,
                    resolve_interval_s=0.35, join_at_frac=0.35,
@@ -4105,6 +4538,37 @@ def main() -> int:
              "mark it out on the FIRST observation",
     )
     p.add_argument(
+        "--incident-ab", type=float, default=0, metavar="SECONDS",
+        help="INSTEAD of the sweep: incident flight-recorder acceptance "
+             "(GUIDE 10m) -- a stall arm (flapping dispatch-stall on a "
+             "stub replica behind the real gateway), a brownout arm "
+             "(best-effort flood climbs the ladder), and an overhead arm "
+             "(recorder on vs off closed-loop throughput); rc=0 iff each "
+             "flapping trigger yields EXACTLY ONE deduped bundle with a "
+             "monotonic causal timeline (the stall bundle pinning the "
+             "firing request's trace), captures land < 2s, the gateway "
+             "merges both tiers' bundles into one causal window, and "
+             "recorder-on holds >= 0.98x recorder-off img/s",
+    )
+    p.add_argument(
+        "--incident-device-ms", type=float, default=40.0,
+        help="simulated device ms per batch for the --incident-ab stub "
+             "tiers (the overhead arm always uses 0)",
+    )
+    p.add_argument(
+        "--incident-deadline-ms", type=float, default=1500.0,
+        help="per-request deadline budget for the --incident-ab stall arm",
+    )
+    p.add_argument(
+        "--incident-rate-rps", type=float, default=24.0,
+        help="offered request rate for --incident-ab (the brownout flood "
+             "runs at 10x this)",
+    )
+    p.add_argument(
+        "--incident-seed", type=int, default=0,
+        help="deterministic seed for the --incident-ab fixtures",
+    )
+    p.add_argument(
         "--churn-ab", type=float, default=0, metavar="SECONDS",
         help="INSTEAD of the sweep: elastic-fleet churn A/B -- front stub "
              "model-tier replicas with the real gateway under dynamic "
@@ -4279,7 +4743,7 @@ def main() -> int:
                      "batcher_sweep", "host_saturation", "overload_ab",
                      "chaos_ab", "churn_ab", "cache_ab", "trace_breakdown",
                      "multimodel_ab", "obs_overhead_ab", "quant_ab",
-                     "tenant_ab"):
+                     "tenant_ab", "incident_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -4358,6 +4822,13 @@ def main() -> int:
                 "light_deadline_ms": args.mm_light_deadline_ms,
                 "rate_x": args.mm_rate_x,
                 "light_rps": args.mm_light_rps,
+            },
+            "incident": {
+                "duration_s": args.incident_ab,
+                "device_ms": args.incident_device_ms,
+                "deadline_ms": args.incident_deadline_ms,
+                "rate_rps": args.incident_rate_rps,
+                "seed": args.incident_seed,
             },
             "tenant": {
                 "duration_s": args.tenant_ab,
@@ -4492,6 +4963,17 @@ def main() -> int:
             probe_interval_s=args.churn_probe_s,
             resolve_interval_s=args.churn_resolve_s,
             seed=args.churn_seed,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.incident_ab > 0:
+        out, rc = bench_incident_ab(
+            duration_s=args.incident_ab,
+            device_ms=args.incident_device_ms,
+            deadline_ms=args.incident_deadline_ms,
+            rate_rps=args.incident_rate_rps,
+            seed=args.incident_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

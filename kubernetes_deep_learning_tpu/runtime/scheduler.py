@@ -329,6 +329,33 @@ class UnifiedScheduler:
     def lane(self, name: str) -> Lane | None:
         return self._lanes.get(name)
 
+    def lanes_snapshot(self) -> dict:
+        """Point-in-time per-lane state for the incident flight recorder
+        (utils/flightrecorder.py): what each model's queue looked like at
+        capture time -- depth, pending images, decayed device-second
+        share, cost EWMA.  Cheap and lock-consistent; JSON-ready."""
+        now = time.monotonic()
+        with self._cond:
+            return {
+                "policy": self.policy,
+                "stalled": self.stalled,
+                "lanes": {
+                    name: {
+                        "weight": lane.weight,
+                        "queue_depth": len(lane.queue),
+                        "pending_images": lane.pending_images,
+                        "queue_cap": lane.queue_cap,
+                        "max_delay_s": lane.max_delay_s,
+                        "served_s": round(lane.decayed_served(now), 6),
+                        "cost_per_image_s": (
+                            round(lane.cost_per_image_s, 6)
+                            if lane.cost_per_image_s is not None else None
+                        ),
+                    }
+                    for name, lane in self._lanes.items()
+                },
+            }
+
     # --- request intake -----------------------------------------------------
 
     def submit(self, model: str, image: np.ndarray, deadline=None,

@@ -206,6 +206,24 @@ def render_classes(payload: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_debug_index(gateway_url: str, timeout: float = 5.0) -> dict:
+    """GET the gateway's /debug/ index: every diagnostic route it serves
+    with a one-line description, so an operator can discover the rest."""
+    import requests
+
+    r = requests.get(f"{gateway_url}/debug/", timeout=timeout)
+    r.raise_for_status()
+    return r.json()
+
+
+def render_debug_index(payload: dict) -> str:
+    """ASCII footer listing the tier's diagnostic surface."""
+    lines = [f"debug index ({payload.get('tier', '?')} tier):"]
+    for route, desc in sorted((payload.get("routes") or {}).items()):
+        lines.append(f"  {route:<28s} {desc}")
+    return "\n".join(lines)
+
+
 def fetch_pool(gateway_url: str, timeout: float = 5.0) -> dict:
     """GET the gateway's /debug/pool view: membership, per-replica
     health/quarantine/drain state, picks, and the latency EWMA driving
@@ -380,6 +398,13 @@ def main(argv: list[str] | None = None) -> int:
             print(render_pool(fetch_pool(args.gateway)), file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - diagnostics only
             print(f"# pool fetch failed: {e}", file=sys.stderr)
+        # The /debug/ index footer: what else the gateway can tell you
+        # (incidents, traces, SLO) without memorizing routes.
+        try:
+            print(render_debug_index(fetch_debug_index(args.gateway)),
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - diagnostics only
+            print(f"# debug index fetch failed: {e}", file=sys.stderr)
     if args.trace:
         from kubernetes_deep_learning_tpu.utils.trace import render_waterfall
 

@@ -64,6 +64,7 @@ from kubernetes_deep_learning_tpu.serving.upstream import (
     UpstreamPool,
     resolve_serving_host,
 )
+from kubernetes_deep_learning_tpu.utils import flightrecorder as incident_lib
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 from kubernetes_deep_learning_tpu.utils import slo as slo_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
@@ -147,6 +148,10 @@ class Gateway:
         brownout_exit: float | None = None,
         brownout_dwell_s: float | None = None,
         brownout_eval_s: float = BROWNOUT_EVAL_S,
+        incident: bool | None = None,
+        incident_dir: str | None = None,
+        incident_triggers: str | None = None,
+        incident_dedup_s: float | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -210,6 +215,17 @@ class Gateway:
         self.admission = AdmissionController(
             self.registry, tier="gateway", enabled=admission
         )
+        # Incident flight recorder (utils.flightrecorder): the IO tier's
+        # black box.  Every failure edge below (brownout ladder, burn
+        # crossings, shed bursts, breaker opens, pool churn) records into
+        # its timeline, and the trigger engine turns sustained signals
+        # into /debug/incidents bundles.  Built BEFORE the brownout loop
+        # thread (which feeds it) and the pool (which takes its hook).
+        self.recorder = incident_lib.FlightRecorder(
+            "gateway", self.registry, tracer=self.tracer,
+            enabled=incident, incident_dir=incident_dir,
+            triggers=incident_triggers, dedup_s=incident_dedup_s,
+        )
         # Brownout (serving.admission.brownout): the slow loop.  When the
         # SLO burn rate stays unsustainable, the ladder degrades serving in
         # stages -- hedges off, stale cache serves, then shedding the lower
@@ -260,8 +276,16 @@ class Gateway:
             probe_interval_s=probe_interval_s,
             resolver=resolver,
             resolve_interval_s=pool_resolve_s,
+            on_event=self.recorder.record,
         )
         self.pool.start_probing()
+        # What a bundle snapshots: the same documents the /debug pages
+        # serve, captured at fire time (the pages themselves only show
+        # NOW; the bundle is the page as of the incident).
+        self.recorder.add_snapshot_provider("slo", self.slo.debug_payload)
+        self.recorder.add_snapshot_provider("brownout", self._brownout_debug)
+        self.recorder.add_snapshot_provider("pool", self.pool.debug_payload)
+        self.recorder.add_snapshot_provider("cache", self._cache_debug)
         # Fault injection (serving.faults): the gateway.upstream point;
         # None (zero-overhead) unless $KDLT_FAULTS configures rules.
         self._faults = faults_lib.from_env()
@@ -281,7 +305,25 @@ class Gateway:
     def _brownout_loop(self) -> None:
         while not self._brownout_stop.wait(self._brownout_eval_s):
             try:
+                prev_stage = self.brownout.stage
                 self.brownout.evaluate()
+                stage = self.brownout.stage
+                # Flight-recorder feed: the eval tick is the one place
+                # that sees every slow-loop signal -- ladder moves, burn
+                # crossings (edge-detected inside the recorder against
+                # the burn-crossing trigger threshold), and shed bursts
+                # (delta of the O(1) note_shed ticks from the hot path).
+                burn = round(self.brownout.max_burn(), 4)
+                if stage > prev_stage:
+                    self.recorder.record(
+                        "brownout.enter", stage=stage, burn=burn
+                    )
+                elif stage < prev_stage:
+                    self.recorder.record(
+                        "brownout.exit", stage=stage, burn=burn
+                    )
+                self.recorder.observe_burn(burn)
+                self.recorder.tick_shed_burst()
             except Exception:  # noqa: BLE001 - the loop must outlive a blip
                 continue
 
@@ -777,6 +819,8 @@ class Gateway:
                     # Every replica refused up front: fast local shed
                     # instead of a thread-pinning timeout per request.
                     self.admission.count_shed("breaker_open")
+                    self.recorder.note_shed()
+                    self.recorder.record("breaker.open", rid=request_id or None)
                     raise UpstreamError(
                         "model tier circuit breaker is open",
                         503,
@@ -1014,24 +1058,18 @@ class Gateway:
             # The response cache's operator surface: sizing, hit ratio,
             # per-model residency, resolved artifact hashes, and the
             # singleflight's live flight count.
-            if self.cache is None:
-                payload: dict = {"enabled": False}
-            else:
-                payload = {
-                    "enabled": True,
-                    **self.cache.stats(),
-                    **self._singleflight.stats(),
-                }
-            return 200, json.dumps(payload).encode(), "application/json"
+            return (
+                200, json.dumps(self._cache_debug()).encode(),
+                "application/json",
+            )
         if path == "/debug/brownout":
             # The degradation ladder's operator surface: live stage, burn
             # vs the enter/exit thresholds, transition history, per-class
             # admitted/shed counts, and the limiter's per-model shares.
-            payload = self.brownout.debug_payload()
-            payload["classes"] = self.admission.class_stats()
-            limiter = self.admission.limiter
-            payload["shares"] = limiter.shares() if limiter is not None else {}
-            return 200, json.dumps(payload).encode(), "application/json"
+            return (
+                200, json.dumps(self._brownout_debug()).encode(),
+                "application/json",
+            )
         if path == "/debug/pool":
             # The replica pool's operator surface: membership, per-replica
             # health/quarantine/drain state, picks, and the latency EWMA
@@ -1042,9 +1080,123 @@ class Gateway:
                 json.dumps(self.pool.debug_payload()).encode(),
                 "application/json",
             )
+        if path in ("/debug", "/debug/"):
+            # The debug INDEX: every debug surface this tier serves, with
+            # a one-line description -- so operators (and kdlt-client
+            # --stats) need not memorize the route list.
+            return (
+                200, json.dumps(self.debug_index()).encode(),
+                "application/json",
+            )
+        if path in ("/debug/incidents", "/debug/incidents/"):
+            return (
+                200, json.dumps(self.handle_incidents()).encode(),
+                "application/json",
+            )
+        if path.startswith("/debug/incidents/"):
+            return self.handle_incident(path.rsplit("/", 1)[-1])
         if path.startswith("/debug/trace/"):
             return self.handle_trace(path.rsplit("/", 1)[-1])
         return 404, b'{"error": "not found"}', "application/json"
+
+    def _cache_debug(self) -> dict:
+        if self.cache is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            **self.cache.stats(),
+            **self._singleflight.stats(),
+        }
+
+    def _brownout_debug(self) -> dict:
+        payload = self.brownout.debug_payload()
+        payload["classes"] = self.admission.class_stats()
+        limiter = self.admission.limiter
+        payload["shares"] = limiter.shares() if limiter is not None else {}
+        return payload
+
+    def debug_index(self) -> dict:
+        """GET /debug/: this tier's debug routes, one line each."""
+        return {
+            "tier": "gateway",
+            "routes": {
+                "/debug/slo": "merged fleet SLO view: gateway-observed + "
+                "every replica's goodput and burn windows",
+                "/debug/cache": "response cache sizing, hit ratio, "
+                "per-model residency, live singleflight count",
+                "/debug/brownout": "degradation ladder stage, burn vs "
+                "thresholds, transitions, per-class shed accounting",
+                "/debug/pool": "upstream membership and per-replica "
+                "health/quarantine/drain, picks, latency EWMA",
+                "/debug/incidents": "flight-recorder bundles (own + "
+                "replicas'), merged into causal windows",
+                "/debug/incidents/<id>": "one full incident bundle "
+                "(timeline, pinned traces, snapshots, metrics delta)",
+                "/debug/trace/<rid>": "merged cross-tier span waterfall "
+                "for one request id",
+            },
+        }
+
+    def handle_incidents(self) -> dict:
+        """GET /debug/incidents: this tier's bundles plus every model-tier
+        replica's, merged into causal windows (one failure fires triggers
+        on several processes within seconds; the window groups them).
+        Unreachable replicas degrade to error entries, never a failed
+        response -- incident review must work during the incident."""
+        payload = self.recorder.debug_payload()
+        own = payload["incidents"]
+        for e in own:
+            e["origin"] = "gateway"
+        entries = list(own)
+        replicas: dict[str, object] = {}
+        for replica in self.pool.replicas:
+            try:
+                r = self._session().get(
+                    f"{replica.base}/debug/incidents", timeout=2.0
+                )
+                if r.status_code != 200:
+                    replicas[replica.host] = {
+                        "error": f"status {r.status_code}"
+                    }
+                    continue
+                body = r.json()
+                remote = body.get("incidents", [])
+                for e in remote:
+                    e["origin"] = replica.host
+                replicas[replica.host] = remote
+                entries.extend(remote)
+            except Exception as e:  # noqa: BLE001 - partial views beat none
+                replicas[replica.host] = {"error": str(e)[:200]}
+        payload["replicas"] = replicas
+        payload["windows"] = incident_lib.merge_windows(entries)
+        return payload
+
+    def handle_incident(self, bundle_id: str) -> tuple[int, bytes, str]:
+        """GET /debug/incidents/<id>: the full bundle -- own first, then
+        each replica is asked (the id encodes nothing about its origin;
+        the gateway is the tier that knows the replica list)."""
+        bundle = self.recorder.get(bundle_id)
+        if bundle is None:
+            for replica in self.pool.replicas:
+                try:
+                    r = self._session().get(
+                        f"{replica.base}/debug/incidents/{bundle_id}",
+                        timeout=2.0,
+                    )
+                    if r.status_code == 200:
+                        bundle = r.json()
+                        break
+                except Exception:  # noqa: BLE001 - try the next replica
+                    continue
+        if bundle is None:
+            return (
+                404,
+                json.dumps(
+                    {"error": f"no incident bundle {bundle_id!r} on any tier"}
+                ).encode(),
+                "application/json",
+            )
+        return 200, json.dumps(bundle).encode(), "application/json"
 
     def handle_slo(self) -> dict:
         """GET /debug/slo: the MERGED fleet SLO view.
@@ -1325,6 +1477,7 @@ class Gateway:
                     )
             except Shed as e:
                 self._m_errors.inc()
+                self.recorder.note_shed()
                 return e.http_status, json.dumps(
                     {"error": str(e), "shed_reason": e.reason}
                 ).encode(), "application/json", e.headers(), n_urls
@@ -1438,6 +1591,7 @@ class Gateway:
                 # that is the capacity being handed back to interactive.
                 self._m_errors.inc()
                 self.admission.count_shed("brownout", priority)
+                self.recorder.note_shed()
                 e = self._brownout_shed(priority)
                 status = e.http_status
                 return status, json.dumps(
@@ -1598,6 +1752,7 @@ class Gateway:
 
     def shutdown(self) -> None:
         self._brownout_stop.set()
+        self.recorder.close()
         if self._microbatcher is not None:
             self._microbatcher.close()
         with self._microbatcher_lock:

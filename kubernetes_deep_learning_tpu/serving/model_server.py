@@ -76,6 +76,7 @@ from kubernetes_deep_learning_tpu.serving.tracing import (
     ensure_span_id,
     log_request,
 )
+from kubernetes_deep_learning_tpu.utils import flightrecorder as incident_lib
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 from kubernetes_deep_learning_tpu.utils import slo as slo_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
@@ -348,6 +349,10 @@ class ModelServer:
         sched_policy: str | None = None,
         sched_weights: dict[str, float] | None = None,
         slo: bool | None = None,
+        incident: bool | None = None,
+        incident_dir: str | None = None,
+        incident_triggers: str | None = None,
+        incident_dedup_s: float | None = None,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -421,6 +426,20 @@ class ModelServer:
                 if admission_enabled(admission) else None
             ),
         )
+        # Incident flight recorder (utils.flightrecorder): the compute
+        # tier's black box.  Dispatch stalls, registry (un)loads, quant-
+        # gate failures, and warm-source cold compiles record into its
+        # timeline; the dispatch-stall trigger captures a bundle with the
+        # causal trace and (opt-in, $KDLT_INCIDENT_PROFILE_S) a short
+        # device profile.  Built BEFORE the model registry: the initial
+        # poll_versions() below already emits registry.load events.
+        self.recorder = incident_lib.FlightRecorder(
+            "model-server", self.registry, tracer=self.tracer,
+            enabled=incident, incident_dir=incident_dir,
+            triggers=incident_triggers, dedup_s=incident_dedup_s,
+            profiler=self._incident_profile,
+        )
+        self.recorder.add_snapshot_provider("slo", self.slo.debug_payload)
         self.model_root = model_root
         self._buckets = buckets
         self._max_delay_ms = max_delay_ms
@@ -445,6 +464,9 @@ class ModelServer:
                 policy=sched_policy,
                 weights=sched_weights,
                 pipeline_depth=pipeline_depth,
+            )
+            self.recorder.add_snapshot_provider(
+                "scheduler", self.scheduler.lanes_snapshot
             )
         # Multi-model registry (serving.registry): scans the artifact root
         # for EVERY model's highest version, keys loads by artifact hash,
@@ -549,12 +571,36 @@ class ModelServer:
             self.registry.remove(fresh.registry_child)
             raise
         fresh.activate()
+        self.recorder.record("registry.load", model=name, version=version)
+        if getattr(fresh.engine, "quant_gate_failed", False):
+            # The int8 warmup tolerance gate refused activations and the
+            # engine downgraded to weight-only: exactly the quiet-but-
+            # consequential edge the incident timeline exists for.
+            self.recorder.record(
+                "quant.gate_fail", model=name, version=version,
+            )
+        report = getattr(fresh.engine, "warm_report", None) or {}
+        for bucket, info in (report.get("buckets") or {}).items():
+            if (info or {}).get("source") == "compile":
+                # A cold compile during warmup: on a fleet that expects
+                # warm-from-cache boots (KDLT_AOT_WARM), this is the
+                # scale-up latency regression signal.
+                self.recorder.record(
+                    "warm.compile", model=name, bucket=bucket,
+                    seconds=(info or {}).get("seconds"),
+                )
         return fresh
 
     def _unload_model(self, old: ServedModel) -> None:
         """ModelRegistry unloader for a superseded version."""
         old.close()
         self.registry.remove(old.registry_child)
+        try:
+            self.recorder.record(
+                "registry.unload", model=old.artifact.spec.name,
+            )
+        except Exception:  # noqa: BLE001 - unload must finish regardless
+            pass
 
     def start_version_watcher(self, interval_s: float = 10.0) -> None:
         """Poll the artifact root for new versions in a daemon thread."""
@@ -685,6 +731,24 @@ class ModelServer:
                     return self._send(200, server.registry.render().encode(), "text/plain")
                 if self.path == "/debug/slo":
                     return self._send_json(200, server.slo.debug_payload())
+                if self.path in ("/debug", "/debug/"):
+                    # The debug INDEX: every debug surface this tier
+                    # serves, one line each (operators should not have to
+                    # memorize the route list).
+                    return self._send_json(200, server.debug_index())
+                if self.path in ("/debug/incidents", "/debug/incidents/"):
+                    return self._send_json(
+                        200, server.recorder.debug_payload()
+                    )
+                if self.path.startswith("/debug/incidents/"):
+                    bundle_id = self.path.rsplit("/", 1)[-1]
+                    bundle = server.recorder.get(bundle_id)
+                    if bundle is None:
+                        return self._send_json(
+                            404,
+                            {"error": f"no incident bundle {bundle_id!r}"},
+                        )
+                    return self._send_json(200, bundle)
                 if self.path.startswith("/debug/trace/"):
                     rid = ensure_request_id(self.path.rsplit("/", 1)[-1])
                     info = server.tracer.trace_info(rid)
@@ -889,6 +953,13 @@ class ModelServer:
                     # out of rotation on the FIRST observation.
                     server._m_errors.inc()
                     status = 503
+                    # Flight recorder: the stall edge, with the causal
+                    # request pinned.  The recorder's dedup window folds
+                    # the storm of per-request DispatchStall responses a
+                    # wedged pipeline produces into ONE bundle.
+                    server.recorder.record(
+                        "dispatch.stall", rid=rid, model=m.group(1),
+                    )
                     self._send_json(
                         503,
                         {"error": f"dispatch stalled: {e}"},
@@ -1054,8 +1125,51 @@ class ModelServer:
         via admission.wait_idle).  The CLI wires SIGTERM here."""
         self.admission.begin_drain()
 
+    def debug_index(self) -> dict:
+        """GET /debug/: this tier's debug routes, one line each."""
+        return {
+            "tier": "model-server",
+            "routes": {
+                "/debug/slo": "per-model goodput and burn-rate windows "
+                "as this replica observed them",
+                "/debug/incidents": "flight-recorder bundles captured on "
+                "this replica",
+                "/debug/incidents/<id>": "one full incident bundle "
+                "(timeline, pinned traces, snapshots, metrics delta)",
+                "/debug/trace/<rid>": "this tier's span waterfall for "
+                "one request id",
+                "/debug/profile?seconds=N": "capture a jax.profiler "
+                "device trace under KDLT_PROFILE_DIR",
+            },
+        }
+
+    def _incident_profile(self, seconds: float) -> dict:
+        """Flight-recorder profile hook (KDLT_INCIDENT_PROFILE_S > 0): the
+        same capture as /debug/profile, same lock -- a concurrent operator
+        capture wins and the bundle notes the skip instead of waiting."""
+        import tempfile
+
+        if self._profile_base is None:
+            return {"skipped": "profiling disabled"}
+        if not self._profile_lock.acquire(blocking=False):
+            return {"skipped": "a profile capture is already running"}
+        try:
+            import jax
+
+            os.makedirs(self._profile_base, exist_ok=True)
+            trace_dir = tempfile.mkdtemp(
+                prefix="kdlt-incident-", dir=self._profile_base
+            )
+            jax.profiler.start_trace(trace_dir)
+            time.sleep(seconds)
+            jax.profiler.stop_trace()
+            return {"trace_dir": trace_dir, "seconds": seconds}
+        finally:
+            self._profile_lock.release()
+
     def shutdown(self) -> None:
         self._watcher_stop.set()
+        self.recorder.close()
         if self._watcher is not None:
             self._watcher.join(timeout=5)
         # BaseServer.shutdown() blocks on serve_forever's exit event; only

@@ -302,7 +302,14 @@ class UpstreamPool:
         unhealthy_after: int = UNHEALTHY_AFTER,
         resolver: Callable[[], list[str]] | None = None,
         resolve_interval_s: float | None = None,
+        on_event: Callable | None = None,
     ):
+        # Flight-recorder hook (utils/flightrecorder.py): called as
+        # ``on_event(kind, **attrs)`` at every membership/health edge so
+        # the owning tier's incident timeline sees pool churn.  Must be
+        # cheap; failures are swallowed (observability never breaks
+        # routing).
+        self._on_event = on_event
         if failover is None:
             failover = os.environ.get(FAILOVER_ENV, "").strip() != "0"
         self.failover = bool(failover)
@@ -432,7 +439,17 @@ class UpstreamPool:
 
     # --- accounting --------------------------------------------------------
 
+    def _emit(self, kind: str, **attrs) -> None:
+        cb = self._on_event
+        if cb is None:
+            return
+        try:
+            cb(kind, **attrs)
+        except Exception:  # noqa: BLE001 - recorder problems never gate routing
+            pass
+
     def record_failure(self, replica: UpstreamReplica) -> None:
+        flipped = False
         with self._lock:
             replica.consecutive_failures += 1
             if (
@@ -440,18 +457,28 @@ class UpstreamPool:
                 and replica.healthy
             ):
                 replica.set_healthy(False)
+                flipped = True
         replica.breaker.record_failure()
+        if flipped:
+            self._emit(
+                "pool.unhealthy", host=replica.host,
+                failures=replica.consecutive_failures,
+            )
 
     def record_success(
         self, replica: UpstreamReplica, latency_s: float | None = None
     ) -> None:
+        flipped = False
         with self._lock:
             replica.consecutive_failures = 0
             if not replica.healthy:
                 replica.set_healthy(True)
+                flipped = True
         if latency_s is not None:
             replica.note_latency(latency_s)
         replica.breaker.record_success()
+        if flipped:
+            self._emit("pool.healthy", host=replica.host, via="traffic")
 
     def mark_stalled(self, replica: UpstreamReplica) -> None:
         """A replica answered with a DECLARED dispatch stall (the
@@ -463,19 +490,35 @@ class UpstreamPool:
         the FIRST observation instead of feeding the wedged replica.
         The /healthz prober rejoins it once the restarted pod answers 200
         (the stalled process fails its own /healthz, so no flapping)."""
+        flipped = False
         with self._lock:
             replica.consecutive_failures = max(
                 replica.consecutive_failures, self._unhealthy_after
             )
+            if replica.healthy:
+                flipped = True
             replica.set_healthy(False)
+        if flipped:
+            # Only the healthy->stalled edge: a wedged replica answers
+            # every queued request with the stall header, and repeating
+            # the pair per response would crowd the bounded timeline.
+            self._emit("pool.stalled", host=replica.host)
+            self._emit("pool.unhealthy", host=replica.host, reason="stalled")
 
     def mark_spec_mismatch(self, replica: UpstreamReplica) -> None:
         """Route around a replica serving a different model contract.  Its
         cached (mismatching) spec is kept: only a health-state rejoin
         (probe success) clears it for re-validation, so a permanently
         wrong replica stays out instead of flapping per request."""
+        flipped = False
         with self._lock:
+            if replica.healthy:
+                flipped = True
             replica.set_healthy(False)
+        if flipped:
+            self._emit(
+                "pool.unhealthy", host=replica.host, reason="spec_mismatch"
+            )
 
     def min_retry_after_s(self) -> float:
         """Smallest positive breaker cool-down across replicas (0 if none):
@@ -544,6 +587,12 @@ class UpstreamPool:
                 "pool membership changed: +%s -%s (now %d members)",
                 joined, [r.host for r in left], len(wanted),
             )
+        for h in joined:
+            self._emit("pool.join", host=h, members=len(wanted))
+            if self.failover:
+                self._emit("pool.quarantine", host=h)
+        for r in left:
+            self._emit("pool.leave", host=r.host, members=len(wanted))
         return {"joined": joined, "left": [r.host for r in left]}
 
     def resolve_now(self) -> dict:
@@ -647,6 +696,7 @@ class UpstreamPool:
                         r.quarantined = False
                         r.set_healthy(True)
                     r.breaker.reset()
+                    self._emit("pool.healthy", host=r.host, via="quarantine")
             elif not r.healthy:
                 if get_status(f"{r.base}/healthz") == 200:
                     with self._lock:
@@ -656,6 +706,10 @@ class UpstreamPool:
                         r.draining = False
                         r.set_healthy(True)
                     r.breaker.reset()
+                    # The probe is the half-open trial for the replica's
+                    # breaker: a 200 re-admits it to rotation.
+                    self._emit("breaker.half_open", host=r.host)
+                    self._emit("pool.healthy", host=r.host, via="probe")
             else:
                 status = get_status(f"{r.base}/readyz")
                 if r.draining:
@@ -667,12 +721,16 @@ class UpstreamPool:
                         with self._lock:
                             r.draining = False
                             r.set_healthy(False)
+                        self._emit(
+                            "pool.unhealthy", host=r.host, reason="drain_dead"
+                        )
                 elif status is not None and status != 200:
                     r.draining = True
                     _log.info(
                         "replica %s readyz=%d: draining (no new primaries)",
                         r.host, status,
                     )
+                    self._emit("pool.drain", host=r.host, status=status)
 
     # --- introspection -----------------------------------------------------
 

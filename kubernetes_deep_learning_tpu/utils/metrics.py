@@ -300,6 +300,7 @@ def slo_model_window_metrics(
 # classified into exactly one of these, and eviction prefers dropping
 # ``routine`` traces first -- the label set is this tuple, nothing else.
 TRACE_RETENTION_CLASSES = (
+    ("incident", "the trace is pinned by a flight-recorder incident bundle"),
     ("error", "the request failed server-side (5xx/disconnect)"),
     ("shed", "the request was shed (503/504)"),
     ("deadline", "the request completed but violated its deadline budget"),
@@ -475,6 +476,61 @@ def brownout_metrics(registry: "Registry") -> dict:
             for direction in ("up", "down")
         },
     }
+
+# Incident flight recorder (utils.flightrecorder): trigger-driven diagnostic
+# bundle capture.  kdlt_incident_* is minted HERE and nowhere else
+# (tools/check_metrics.py confines the prefix and the ``trigger`` label to
+# this module); the trigger vocabulary is exactly this tuple -- the trigger
+# parser rejects unknown names, so the label is bounded by construction.
+INCIDENT_TRIGGERS = (
+    "burn-crossing", "brownout", "dispatch-stall", "replica-unhealthy",
+)
+
+
+def incident_metrics(registry: "Registry") -> dict:
+    """The flight recorder's series: bundles captured / suppressed (dedup or
+    hysteresis swallowed a repeat fire) / dropped (dir caps evicted an old
+    bundle), per trigger, plus how many bundles are currently on disk.
+    Alert on rate(kdlt_incident_captures_total[5m]) > 0 (GUIDE 10m).
+
+    Idempotent per registry (the _memo_on_child pattern): a tier that
+    builds its recorder twice against one registry must not re-mint."""
+    return _memo_on_child(registry, "_kdlt_incident", _mint_incident)
+
+
+def _mint_incident(registry: "Registry") -> dict:
+    return {
+        "captures": {
+            trig: registry.with_labels(trigger=trig).counter(
+                "kdlt_incident_captures_total",
+                "incident bundles captured, by firing trigger",
+            )
+            for trig in INCIDENT_TRIGGERS
+        },
+        "suppressed": {
+            trig: registry.with_labels(trigger=trig).counter(
+                "kdlt_incident_suppressed_total",
+                "trigger fires suppressed inside the dedup window (a "
+                "flapping signal yields ONE bundle plus this counter)",
+            )
+            for trig in INCIDENT_TRIGGERS
+        },
+        "dropped": {
+            trig: registry.with_labels(trigger=trig).counter(
+                "kdlt_incident_dropped_total",
+                "incident bundles evicted oldest-first by the "
+                "KDLT_INCIDENT_MAX_BUNDLES / KDLT_INCIDENT_MAX_MB caps, "
+                "by the evicted bundle's trigger",
+            )
+            for trig in INCIDENT_TRIGGERS
+        },
+        "open": registry.gauge(
+            "kdlt_incident_open",
+            "incident bundles currently retained on disk under "
+            "KDLT_INCIDENT_DIR",
+        ),
+    }
+
 
 # Deadline budgets are ms-scale; the request-latency buckets (seconds) would
 # collapse every remaining-budget observation into two bins.

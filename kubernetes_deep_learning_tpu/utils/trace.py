@@ -112,9 +112,13 @@ class Span:
 # Retention classes, most-protected first.  Eviction walks the ring oldest
 # first but skips protected traces while any routine one remains: the
 # traces tail debugging actually needs (errors, sheds, deadline misses, the
-# slowest percentile) outlive the routine churn around them.
+# slowest percentile) outlive the routine churn around them.  ``incident``
+# outranks everything: the flight recorder (utils/flightrecorder.py) pins a
+# captured bundle's causal traces so they survive until an operator reads
+# the bundle -- an evicted trace would leave the bundle's trace ids dangling.
 RETENTION_PRIORITY = {
-    "error": 4, "shed": 3, "deadline": 2, "slow": 1, "routine": 0,
+    "incident": 5, "error": 4, "shed": 3, "deadline": 2, "slow": 1,
+    "routine": 0,
 }
 
 

@@ -134,6 +134,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--crosshost-ab", "30"], "crosshost_ab"),
         (["--obs-overhead-ab", "5"], "obs_overhead_ab"),
         (["--tenant-ab", "5"], "tenant_ab"),
+        (["--incident-ab", "6"], "incident_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -186,6 +187,28 @@ def test_dry_run_chaos_ab_echoes_the_fault_tolerance_config():
     # The cross-host leader arm (ISSUE 8 satellite): the stall mode must
     # round-trip the CLI.
     assert out["chaos"]["mode"] == "stall"
+
+
+def test_dry_run_incident_ab_echoes_the_flight_recorder_config():
+    # The --incident-ab invocation surface (the incident flight-recorder
+    # acceptance harness, GUIDE 10m) must keep parsing and echo its
+    # resolved knobs without importing jax, binding ports, or spawning
+    # servers.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--incident-ab", "6", "--dry-run",
+         "--incident-device-ms", "25", "--incident-rate-rps", "16",
+         "--incident-seed", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "incident_ab"
+    assert out["incident"]["duration_s"] == 6.0
+    assert out["incident"]["device_ms"] == 25.0
+    assert out["incident"]["rate_rps"] == 16.0
+    assert out["incident"]["seed"] == 3
+    assert out["incident"]["deadline_ms"] == 1500.0
 
 
 def test_dry_run_cache_ab_echoes_the_cache_config():

@@ -777,6 +777,86 @@ def test_slo_target_agrees_across_every_tier_and_topology():
     assert slo_lib.resolve_target(value) == value
 
 
+def test_incident_recorder_envs_agree_across_k8s_and_compose():
+    """Incident flight-recorder wiring (ISSUE 13): every tier copy in both
+    topologies carries the KDLT_INCIDENT_* knobs with values the recorder's
+    own parsers accept, the trigger spec / caps agree everywhere (a replica
+    pair disagreeing on triggers would capture different incidents for the
+    same outage), each tier's bundle dir agrees between compose and k8s,
+    and the k8s dirs live on mounted volumes so bundles survive container
+    restarts."""
+    from kubernetes_deep_learning_tpu.utils.flightrecorder import (
+        DIR_ENV,
+        MAX_BUNDLES_ENV,
+        MAX_MB_ENV,
+        TRIGGERS_ENV,
+        parse_triggers,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    services = compose["services"]
+
+    def k8s_env(dep):
+        (container,) = dep["spec"]["template"]["spec"]["containers"]
+        return {e["name"]: str(e.get("value", "")) for e in container["env"]}
+
+    def compose_env(svc):
+        return {
+            k: str(v) for k, v in services[svc]["environment"].items()
+        }
+
+    copies = {
+        "k8s/gateway": k8s_env(gw_dep),
+        "k8s/model-server": k8s_env(model_dep),
+        "compose/gateway": compose_env("gateway"),
+        "compose/model-server": compose_env("model-server"),
+        "compose/model-server-b": compose_env("model-server-b"),
+    }
+    # Triggers + caps: present everywhere and identical everywhere.
+    for var in (TRIGGERS_ENV, MAX_BUNDLES_ENV, MAX_MB_ENV):
+        values = {where: env.get(var) for where, env in copies.items()}
+        assert all(v is not None for v in values.values()), (
+            f"{var} missing from some tier copy: {values}"
+        )
+        assert len(set(values.values())) == 1, (
+            f"{var} disagrees across tier copies: {values}"
+        )
+    # The trigger spec must parse through the recorder's own grammar and
+    # keep the default rules armed (the deploys must not silently disable
+    # a trigger class the runbooks rely on).
+    triggers = parse_triggers(copies["k8s/gateway"][TRIGGERS_ENV])
+    for name in ("burn-crossing", "brownout", "dispatch-stall",
+                 "replica-unhealthy"):
+        assert name in triggers, f"deploys dropped the {name} trigger"
+    assert int(copies["k8s/gateway"][MAX_BUNDLES_ENV]) > 0
+    assert float(copies["k8s/gateway"][MAX_MB_ENV]) > 0
+
+    # Per-tier dir agreement between compose and k8s (the tiers may use
+    # different paths -- gateway has no XLA cache volume -- but each
+    # tier's compose rehearsal must write where its k8s pod writes).
+    for a, b in (("k8s/gateway", "compose/gateway"),
+                 ("k8s/model-server", "compose/model-server")):
+        assert copies[a].get(DIR_ENV), f"{a} missing {DIR_ENV}"
+        assert copies[a][DIR_ENV] == copies[b].get(DIR_ENV), (
+            f"{DIR_ENV} disagrees between {a} and {b}"
+        )
+
+    # k8s: each tier's bundle dir must live under a mounted volume, or a
+    # container restart (the very event an incident precedes) loses the
+    # evidence.
+    for dep in (gw_dep, model_dep):
+        pod = dep["spec"]["template"]["spec"]
+        (container,) = pod["containers"]
+        env = {e["name"]: str(e.get("value", "")) for e in container["env"]}
+        mounts = [m["mountPath"] for m in container.get("volumeMounts", [])]
+        assert any(env[DIR_ENV].startswith(m) for m in mounts), (
+            f"{DIR_ENV}={env[DIR_ENV]} must live under a mounted volume"
+        )
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

@@ -1,0 +1,462 @@
+"""Incident flight recorder (utils/flightrecorder.py): trigger hysteresis
+and dedup under flapping signals (fake clock), bundle atomicity under
+concurrent triggers, dir-cap eviction oldest-first, the ``incident`` trace
+retention class, the /debug/ index on both tiers, and the gateway's
+cross-replica incident merge.  All device-free."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+from kubernetes_deep_learning_tpu.serving.client import render_debug_index
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+from kubernetes_deep_learning_tpu.utils import trace as trace_lib
+from kubernetes_deep_learning_tpu.utils.flightrecorder import (
+    EVENT_KINDS,
+    TRIGGER_RULES,
+    FlightRecorder,
+    merge_windows,
+    parse_triggers,
+)
+
+
+def _metric(text: str, name: str, **labels: str) -> float:
+    for m in re.finditer(rf"^{re.escape(name)}(\{{[^}}]*\}})? (\S+)$", text, re.M):
+        got = dict(re.findall(r'(\w+)="([^"]*)"', m.group(1) or ""))
+        if all(got.get(k) == v for k, v in labels.items()):
+            return float(m.group(2))
+    return 0.0
+
+
+class FakeClock:
+    """Deterministic monotonic/wall source so dedup-window and hysteresis
+    behavior is tested by *advancing time*, not by sleeping through it."""
+
+    def __init__(self, t: float = 1_700_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _recorder(tmp_path=None, *, registry=None, clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("incident_dir", str(tmp_path / "inc") if tmp_path else "")
+    kw.setdefault("enabled", True)
+    return clock, FlightRecorder(
+        "model-server", registry, clock=clock, wall=clock, **kw
+    )
+
+
+# --- timeline + trigger engine ----------------------------------------------
+
+
+def test_record_rejects_unknown_kinds_and_stamps_events():
+    clock, rec = _recorder()
+    with pytest.raises(ValueError):
+        rec.record("made.up.kind")
+    rec.record("pool.join", replica="r1")
+    (ev,) = rec.events()
+    assert ev["kind"] == "pool.join"
+    assert ev["tier"] == "model-server"
+    assert ev["t"] == ev["m"] == clock.t
+    assert ev["attrs"] == {"replica": "r1"}
+    rec.close()
+
+
+def test_kill_switch_makes_every_hook_a_noop(tmp_path):
+    _, rec = _recorder(tmp_path, enabled=False)
+    rec.record("dispatch.stall")
+    rec.observe_burn(99.0)
+    rec.note_shed()
+    rec.tick_shed_burst(min_burst=0)
+    assert rec.wait_idle(timeout=1.0)
+    assert rec.events() == []
+    assert rec.index() == []
+    rec.close()
+
+
+def test_every_trigger_rule_fires_on_a_known_event_kind():
+    for name, rule in TRIGGER_RULES.items():
+        assert rule["fire"] in EVENT_KINDS, name
+        assert rule["clear"] is None or rule["clear"] in EVENT_KINDS, name
+
+
+def test_parse_triggers_grammar_and_unknown_names():
+    got = parse_triggers("brownout=2, dispatch-stall")
+    assert got == {"brownout": 2.0, "dispatch-stall": None}
+    assert parse_triggers("burn-crossing")["burn-crossing"] == 1.0
+    with pytest.raises(ValueError):
+        parse_triggers("brownout,made-up-trigger")
+    with pytest.raises(ValueError):
+        parse_triggers("brownout=hot")
+
+
+def test_flapping_hysteretic_trigger_yields_one_bundle(tmp_path):
+    """A brownout ladder climbing 1->2->3 flaps the fire kind three times;
+    hysteresis keeps the trigger armed past the dedup window until the
+    clearing exit event, so exactly ONE bundle is captured and every
+    suppressed repeat is counted."""
+    reg = metrics_lib.Registry()
+    clock, rec = _recorder(
+        tmp_path, registry=reg, triggers="brownout=1", dedup_s=10.0
+    )
+    rec.record("brownout.enter", stage=1, burn=2.4)   # fires
+    rec.record("brownout.enter", stage=2, burn=3.1)   # armed -> suppressed
+    clock.advance(60.0)                               # far past dedup
+    rec.record("brownout.enter", stage=3, burn=4.0)   # STILL armed
+    assert rec.wait_idle()
+    assert len(rec.index()) == 1
+    assert rec.index()[0]["trigger"] == "brownout"
+    text = reg.render()
+    assert _metric(text, "kdlt_incident_captures_total", trigger="brownout") == 1
+    assert _metric(text, "kdlt_incident_suppressed_total", trigger="brownout") == 2
+
+    # The clearing signal re-arms; a fresh fire past the dedup window is a
+    # genuinely new incident and captures a second bundle.
+    rec.record("brownout.exit", stage=0, burn=0.4)
+    clock.advance(60.0)
+    rec.record("brownout.enter", stage=1, burn=2.2)
+    assert rec.wait_idle()
+    assert len(rec.index()) == 2
+
+    # Cleared but still INSIDE the dedup window: suppressed, not captured.
+    rec.record("brownout.exit", stage=0, burn=0.3)
+    clock.advance(1.0)
+    rec.record("brownout.enter", stage=1, burn=2.9)
+    assert rec.wait_idle()
+    assert len(rec.index()) == 2
+    assert (
+        _metric(reg.render(), "kdlt_incident_suppressed_total", trigger="brownout")
+        == 3
+    )
+    rec.close()
+
+
+def test_dispatch_stall_rearms_on_dedup_window_alone(tmp_path):
+    clock, rec = _recorder(tmp_path, triggers="dispatch-stall", dedup_s=10.0)
+    rec.record("dispatch.stall", rid="aaaa0001")
+    clock.advance(1.0)
+    rec.record("dispatch.stall", rid="aaaa0002")  # inside dedup: suppressed
+    assert rec.wait_idle()
+    assert len(rec.index()) == 1
+    clock.advance(30.0)                           # no clear kind exists --
+    rec.record("dispatch.stall", rid="aaaa0003")  # the window alone re-arms
+    assert rec.wait_idle()
+    assert len(rec.index()) == 2
+    rec.close()
+
+
+def test_burn_crossing_is_edge_detected_at_the_trigger_threshold(tmp_path):
+    clock, rec = _recorder(tmp_path, triggers="burn-crossing=2.0", dedup_s=5.0)
+    assert rec.trigger_threshold("burn-crossing", 1.0) == 2.0
+    rec.observe_burn(0.5)   # primes the edge detector
+    rec.observe_burn(2.5)   # up-cross -> event + capture
+    rec.observe_burn(2.8)   # above but no crossing: no event
+    rec.observe_burn(1.0)   # down-cross -> clearing event
+    clock.advance(30.0)
+    rec.observe_burn(3.0)   # second genuine crossing
+    assert rec.wait_idle()
+    kinds = [
+        (e["kind"], (e.get("attrs") or {}).get("direction"))
+        for e in rec.events()
+        if e["kind"] == "burn.cross"
+    ]
+    assert kinds == [("burn.cross", "up"), ("burn.cross", "down"),
+                     ("burn.cross", "up")]
+    assert len(rec.index()) == 2
+    rec.close()
+
+
+def test_shed_burst_coalesces_ticks(tmp_path):
+    _, rec = _recorder(tmp_path)
+    for _ in range(12):
+        rec.note_shed()
+    rec.tick_shed_burst(min_burst=10)
+    rec.note_shed()
+    rec.tick_shed_burst(min_burst=10)  # only 1 new shed: below the burst bar
+    bursts = [e for e in rec.events() if e["kind"] == "shed.burst"]
+    assert len(bursts) == 1
+    assert bursts[0]["attrs"]["count"] == 12
+    rec.close()
+
+
+# --- bundle capture: atomicity, caps, persistence ---------------------------
+
+
+def test_concurrent_triggers_write_complete_atomic_bundles(tmp_path):
+    """Eight threads fire simultaneously (dedup disabled): every bundle on
+    disk must parse as complete JSON with a unique id and no torn .tmp
+    leftovers -- the capture worker serializes writes and publishes each
+    via os.replace."""
+    _, rec = _recorder(tmp_path, triggers="dispatch-stall", dedup_s=0.0)
+    barrier = threading.Barrier(8)
+
+    def fire(i: int) -> None:
+        barrier.wait()
+        rec.record("dispatch.stall", rid=f"cafe{i:04d}")
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.wait_idle()
+    index = rec.index()
+    assert len(index) == 8
+    assert len({e["id"] for e in index}) == 8
+    names = os.listdir(tmp_path / "inc")
+    assert not [n for n in names if n.endswith(".tmp")]
+    assert len([n for n in names if n.endswith(".json")]) == 8
+    for entry in index:
+        with open(entry["path"], encoding="utf-8") as f:
+            bundle = json.load(f)
+        assert bundle["id"] == entry["id"]
+        assert bundle["trigger"] == "dispatch-stall"
+        for key in ("events", "snapshots", "traces", "metrics_delta",
+                    "captured_at_s", "capture_latency_s"):
+            assert key in bundle, key
+        stamps = [e["m"] for e in bundle["events"]]
+        assert stamps == sorted(stamps)  # timeline is ordered
+    rec.close()
+
+
+def test_dir_count_cap_evicts_oldest_first_and_counts_drops(tmp_path):
+    reg = metrics_lib.Registry()
+    clock, rec = _recorder(
+        tmp_path, registry=reg, triggers="dispatch-stall",
+        dedup_s=0.0, max_bundles=3,
+    )
+    for i in range(6):
+        clock.advance(1.0)
+        rec.record("dispatch.stall", rid=f"beef{i:04d}")
+        assert rec.wait_idle()  # deterministic capture order
+    index = rec.index()  # newest first
+    assert len(index) == 3
+    fired = [e["fired_at_s"] for e in index]
+    assert fired == sorted(fired, reverse=True)
+    # The three oldest are gone from disk too, not just the index.
+    on_disk = {
+        n[:-5] for n in os.listdir(tmp_path / "inc") if n.endswith(".json")
+    }
+    assert on_disk == {e["id"] for e in index}
+    text = reg.render()
+    assert _metric(
+        text, "kdlt_incident_dropped_total", trigger="dispatch-stall"
+    ) == 3
+    assert _metric(text, "kdlt_incident_open") == 3
+    rec.close()
+
+
+def test_byte_cap_evicts_down_to_at_least_one_bundle(tmp_path):
+    clock, rec = _recorder(
+        tmp_path, triggers="dispatch-stall", dedup_s=0.0,
+        max_bundles=100, max_mb=1e-6,  # cap smaller than any single bundle
+    )
+    for i in range(3):
+        clock.advance(1.0)
+        rec.record("dispatch.stall", rid=f"feed{i:04d}")
+        assert rec.wait_idle()
+    # The byte cap can never evict the LAST bundle: an incident store that
+    # deletes the only evidence it holds is worse than an over-budget one.
+    assert len(rec.index()) == 1
+    rec.close()
+
+
+def test_restart_reindexes_surviving_bundles_from_disk(tmp_path):
+    clock, rec = _recorder(tmp_path, triggers="dispatch-stall", dedup_s=0.0)
+    for i in range(2):
+        clock.advance(1.0)
+        rec.record("dispatch.stall", rid=f"dead{i:04d}")
+    assert rec.wait_idle()
+    ids = [e["id"] for e in rec.index()]
+    rec.close()
+
+    _, reborn = _recorder(tmp_path)  # same dir, fresh process state
+    assert [e["id"] for e in reborn.index()] == ids
+    bundle = reborn.get(ids[0])      # memory mirror is empty: disk path
+    assert bundle is not None and bundle["id"] == ids[0]
+    assert reborn.get("inc-nope") is None
+    reborn.close()
+
+
+# --- incident trace retention class -----------------------------------------
+
+
+def test_capture_pins_causal_traces_against_eviction(tmp_path):
+    reg = metrics_lib.Registry()
+    tracer = trace_lib.Tracer("model-server", max_traces=8, registry=reg)
+    rid = "abcd1234abcd1234"
+    tracer.record(rid, "predict", 0.0, 0.05)
+    _, rec = _recorder(
+        tmp_path, registry=reg, tracer=tracer,
+        triggers="dispatch-stall", dedup_s=0.0,
+    )
+    rec.record("dispatch.stall", rid=rid)
+    assert rec.wait_idle()
+    (entry,) = rec.index()
+    assert entry["traces"] == [rid]
+    assert rid in rec.get(entry["id"])["traces"]
+    # Pinned ``incident`` class: a storm of routine traces far past the
+    # ring capacity must not evict the bundle's causal trace.
+    for i in range(32):
+        tracer.record(f"{i:016x}", "routine", 0.0, 0.001)
+    assert tracer.trace_info(rid) is not None
+    assert _metric(
+        reg.render(), "kdlt_trace_retained_total", **{"class": "incident"}
+    ) >= 1
+    # Upgrade-only: nothing can demote an incident-pinned trace.
+    tracer.classify(rid, "routine")
+    for _ in range(16):
+        tracer.record(f"{os.urandom(8).hex()}", "routine", 0.0, 0.001)
+    assert tracer.trace_info(rid) is not None
+    rec.close()
+
+
+def test_incident_outranks_every_other_retention_class():
+    pri = trace_lib.RETENTION_PRIORITY
+    assert pri["incident"] == max(pri.values())
+
+
+# --- causal windows ----------------------------------------------------------
+
+
+def test_merge_windows_groups_nearby_incidents_across_origins():
+    entries = [
+        {"id": "inc-a", "origin": "gateway", "tier": "gateway",
+         "trigger": "replica-unhealthy", "fired_at_s": 100.0},
+        {"id": "inc-b", "origin": "127.0.0.1:8500", "tier": "model-server",
+         "trigger": "dispatch-stall", "fired_at_s": 112.0},
+        {"id": "inc-c", "origin": "gateway", "tier": "gateway",
+         "trigger": "brownout", "fired_at_s": 500.0},
+        {"id": "inc-skip", "origin": "gateway", "trigger": "brownout"},
+    ]
+    windows = merge_windows(entries, window_s=30.0)
+    assert len(windows) == 2
+    first = windows[0]
+    assert [i["id"] for i in first["incidents"]] == ["inc-a", "inc-b"]
+    assert {i["origin"] for i in first["incidents"]} == {
+        "gateway", "127.0.0.1:8500"
+    }
+    assert set(first["triggers"]) == {"replica-unhealthy", "dispatch-stall"}
+    assert windows[1]["incidents"][0]["id"] == "inc-c"
+
+
+# --- through the real tiers ---------------------------------------------------
+
+
+IMG = np.zeros((1, 32, 32, 3), np.uint8)
+
+
+def _make_stub_server(name, tmp_path, subdir="models", **kw):
+    spec = register_spec(
+        ModelSpec(
+            name=name, family="xception", input_shape=(32, 32, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    root = tmp_path / subdir
+    art.save_artifact(
+        art.version_dir(str(root), spec.name, 1), spec, {"params": {}}, None, {}
+    )
+    server = ModelServer(
+        str(root), port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+        engine_factory=lambda a, **ekw: StubEngine(a, **ekw), **kw,
+    )
+    server.warmup()
+    server.start()
+    return spec, server
+
+
+def test_debug_index_served_on_both_tiers(tmp_path):
+    requests = pytest.importorskip("requests")
+    spec, server = _make_stub_server("inc-index", tmp_path)
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, bind=False, probe_interval_s=0,
+    )
+    try:
+        r = requests.get(f"http://127.0.0.1:{server.port}/debug/", timeout=5)
+        assert r.status_code == 200
+        body = r.json()
+        assert body["tier"] == "model-server"
+        assert "/debug/incidents" in body["routes"]
+        assert "/debug/trace/<rid>" in body["routes"]
+        gw_index = gw.debug_index()
+        assert gw_index["tier"] == "gateway"
+        for route in ("/debug/slo", "/debug/brownout", "/debug/pool",
+                      "/debug/cache", "/debug/incidents"):
+            assert route in gw_index["routes"], route
+        # The kdlt-client --stats footer renders this payload directly.
+        footer = render_debug_index(gw_index)
+        assert footer.startswith("debug index (gateway tier):")
+        assert "/debug/incidents" in footer
+    finally:
+        gw.shutdown()
+        server.shutdown()
+
+
+def test_gateway_merges_replica_bundles_and_serves_them_by_id(tmp_path):
+    """The stalled-replica shape end to end: the model tier captures a
+    dispatch-stall bundle, the gateway captures its own replica-unhealthy
+    bundle, and /debug/incidents on the gateway shows both -- tagged by
+    origin, merged into one causal window -- and resolves the REPLICA's
+    bundle id even though the gateway never stored it."""
+    spec, server = _make_stub_server(
+        "inc-merge", tmp_path,
+        incident=True, incident_dir=str(tmp_path / "ms-inc"),
+    )
+    gw = Gateway(
+        serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+        port=0, bind=False, probe_interval_s=0,
+        incident=True, incident_dir=str(tmp_path / "gw-inc"),
+    )
+    try:
+        server.recorder.record("dispatch.stall", rid="cafecafe00000001")
+        assert server.recorder.wait_idle()
+        gw.recorder.record(
+            "pool.unhealthy", replica=f"127.0.0.1:{server.port}"
+        )
+        assert gw.recorder.wait_idle()
+
+        payload = gw.handle_incidents()
+        own = [e for e in payload["incidents"] if e["origin"] == "gateway"]
+        assert own and own[0]["trigger"] == "replica-unhealthy"
+        (remote_list,) = payload["replicas"].values()
+        assert remote_list and remote_list[0]["trigger"] == "dispatch-stall"
+        assert remote_list[0]["tier"] == "model-server"
+
+        windows = payload["windows"]
+        assert len(windows) == 1
+        assert {i["origin"] for i in windows[0]["incidents"]} == {
+            "gateway", f"127.0.0.1:{server.port}"
+        }
+        assert set(windows[0]["triggers"]) == {
+            "replica-unhealthy", "dispatch-stall"
+        }
+
+        remote_id = remote_list[0]["id"]
+        status, body, ctype = gw.handle_incident(remote_id)
+        assert status == 200 and ctype == "application/json"
+        bundle = json.loads(body)
+        assert bundle["id"] == remote_id
+        assert bundle["trigger"] == "dispatch-stall"
+        status, body, _ = gw.handle_incident("inc-nope")
+        assert status == 404
+    finally:
+        gw.shutdown()
+        server.shutdown()

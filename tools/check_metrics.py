@@ -27,8 +27,9 @@ Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
   bucket) no matter what names a caller feeds in.  The same rule covers
   the other bounded labels: ``window`` (the SLO engine's fixed window set),
   ``class`` (the tracer's retention classes), ``reason`` (cache eviction
-  reasons), ``scheme`` (the quantization scheme list), and ``source`` (the
-  warmup provenance pair);
+  reasons), ``scheme`` (the quantization scheme list), ``source`` (the
+  warmup provenance pair), and ``trigger`` (the flight recorder's fixed
+  trigger-rule names);
 - ``kdlt_slo_*`` series must be minted inside utils/metrics.py: the SLO
   engine's gauge matrix is (bounded model) x (fixed window), and a module
   minting its own slice would bypass both bounds at once;
@@ -53,19 +54,21 @@ METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 # (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
 # the trace retention classes; reason: the cache eviction reasons; scheme:
 # the quantization scheme list; source: the warmup provenance pair;
-# stage/direction: the brownout ladder's four stages and two directions) --
-# attaching them anywhere else escapes the bound.
+# stage/direction: the brownout ladder's four stages and two directions;
+# trigger: the flight recorder's fixed trigger-rule names) -- attaching
+# them anywhere else escapes the bound.
 CENTRAL_LABELS = {
     "model", "window", "class", "reason", "scheme", "source",
-    "stage", "direction",
+    "stage", "direction", "trigger",
 }
 # Series prefixes whose minting is confined to utils/metrics.py even beyond
 # the general helper conventions (the SLO gauge matrix, the response
-# cache's series, the quantization scheme/gate series, and the dynamic-
-# membership pool series: all carry bounded labels a stray mint would
-# escape).
+# cache's series, the quantization scheme/gate series, the dynamic-
+# membership pool series, and the flight recorder's incident series: all
+# carry bounded labels a stray mint would escape).
 CENTRAL_PREFIXES = (
     "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
+    "kdlt_incident_",
 )
 # Exact series names likewise confined to utils/metrics.py: these live
 # under prefixes too broad to confine wholesale (kdlt_engine_* is minted
@@ -208,7 +211,7 @@ def lint_source(src: str, rel: str) -> list[str]:
                 violations.append(
                     f"{rel}:{node.lineno}: {head!r} minted outside "
                     "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
-                    "kdlt_pool_*/kdlt_brownout_* series (and "
+                    "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_* series (and "
                     "kdlt_engine_warm_source) are minted only by the central "
                     "helpers (bounded label sets by construction)"
                 )
