@@ -203,7 +203,7 @@ class InFlightDispatcher:
         import queue as queue_lib
 
         self._completions: queue_lib.Queue = queue_lib.Queue()
-        self._closed = False
+        self._closed = False         # guarded-by: _close_lock
         self._close_lock = threading.Lock()
         registry = registry or getattr(engine, "registry", None) or metrics_lib.Registry()
         self._registry = registry
@@ -237,10 +237,10 @@ class InFlightDispatcher:
         # bucket) key, dispatch time)) the watchdog scans, per-key EWMA of
         # observed dispatch->sync latency, and the terminal "stalled" flag.
         self._stalled = threading.Event()
-        self._inflight: dict[int, tuple[Future, tuple, float]] = {}
+        self._inflight: dict[int, tuple[Future, tuple, float]] = {}  # guarded-by: _inflight_lock
         self._inflight_lock = threading.Lock()
-        self._seq = 0
-        self._expected_s: dict[tuple, float] = {}
+        self._seq = 0                # guarded-by: _inflight_lock
+        self._expected_s: dict[tuple, float] = {}  # guarded-by: _inflight_lock
         if watchdog is None:
             watchdog = os.environ.get(WATCHDOG_ENV, "").strip() != "0"
         self._stall_multiple = (
@@ -333,6 +333,7 @@ class InFlightDispatcher:
         t0 = time.perf_counter()
         w0 = trace_lib.now_s() if traces else 0.0
         self._slots.acquire()
+        # kdlt-lint: disable=guarded-by -- the slot-semaphore handshake orders this read: close() drains every slot before flipping _closed, so a submit holding a slot observes the flip or the drain, never a torn state
         if self._closed:
             self._slots.release()
             raise DispatcherClosed("dispatcher is shut down")
@@ -421,10 +422,10 @@ class InFlightDispatcher:
             w4 = w3 + (t1 - t0)
             try:
                 for tr in traces:
-                    tr.record("pipeline.enqueue_wait", w0, w1 - w0)
-                    tr.record("pipeline.dispatch", w1, w2 - w1)
-                    tr.record("pipeline.execute", w2, w3 - w2)
-                    tr.record("pipeline.readback", w3, w4 - w3)
+                    tr.record(trace_lib.SPAN_PIPELINE_ENQUEUE_WAIT, w0, w1 - w0)
+                    tr.record(trace_lib.SPAN_PIPELINE_DISPATCH, w1, w2 - w1)
+                    tr.record(trace_lib.SPAN_PIPELINE_EXECUTE, w2, w3 - w2)
+                    tr.record(trace_lib.SPAN_PIPELINE_READBACK, w3, w4 - w3)
             except Exception:  # noqa: BLE001 - tracing must not stall results
                 pass
         self._slots.release()
@@ -960,6 +961,7 @@ class InferenceEngine:
             )
         finally:
             self._quantization_active = prev
+        # kdlt-lint: disable=donation-safety -- x is a host numpy batch; donation consumes device-resident jax.Arrays only, a host array is copied at dispatch and stays valid
         ref = np.asarray(ref_fn(self._variables, x))[:b]
         drift = float(
             np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
@@ -1218,6 +1220,7 @@ class InferenceEngine:
         InFlightDispatcher is the general pipelining wrapper over this
         hook: bounded in-flight depth, FIFO completion thread, futures.
         """
+        # kdlt-lint: disable=hot-path-sync -- normalizes the caller's host input (list/bytes -> ndarray); no device handle is involved, so nothing can block on device work
         images = np.asarray(images)
         if images.ndim != 4 or images.shape[1:] != self.spec.input_shape:
             raise ValueError(
@@ -1233,6 +1236,7 @@ class InferenceEngine:
         else:
             batch = images
         with self._lock:
+            # kdlt-lint: disable=lock-around-jit -- serialized enqueue is the documented contract: dispatch is async (returns an unmaterialized handle), so the lock covers only the enqueue, and XLA requires donated-buffer dispatches not to interleave
             logits = self._jitted(self._variables, batch)
         return logits, n
 

@@ -169,9 +169,9 @@ class Lane:
         # thread reads shares under the scheduler lock while the
         # dispatcher's completion thread reports served time without it.
         self._share_lock = threading.Lock()
-        self.served_s = 0.0
-        self._served_at = time.monotonic()
-        self.cost_per_image_s: float | None = None
+        self.served_s = 0.0          # guarded-by: _share_lock
+        self._served_at = time.monotonic()  # guarded-by: _share_lock
+        self.cost_per_image_s: float | None = None  # guarded-by: _share_lock
 
     @property
     def max_batch(self) -> int:
@@ -204,7 +204,8 @@ class Lane:
         """Estimated service time of an ``n_images`` batch (0 until the
         first completion seeds the EWMA -- an optimistic cold estimate only
         biases the first batch's ordering)."""
-        return (self.cost_per_image_s or 0.0) * n_images
+        with self._share_lock:
+            return (self.cost_per_image_s or 0.0) * n_images
 
     def effective_deadline(self, now: float) -> float:
         """The lane's urgency: earliest absolute deadline among queued
@@ -252,11 +253,11 @@ class UnifiedScheduler:
         )
         self._owns_dispatcher = dispatcher is None
         self._cond = threading.Condition()
-        self._lanes: dict[str, Lane] = {}
+        self._lanes: dict[str, Lane] = {}  # guarded-by: _cond
         # Lane metrics persist across unregister/re-register cycles (the
         # central mint dedupes by (name, labels); re-minting would raise).
-        self._lane_metrics: dict[str, dict] = {}
-        self._closed = False
+        self._lane_metrics: dict[str, dict] = {}  # guarded-by: _cond
+        self._closed = False         # guarded-by: _cond
         self._m_models = self.registry.gauge(
             "kdlt_sched_models", "models registered with the scheduler"
         )
@@ -327,7 +328,8 @@ class UnifiedScheduler:
                 )
 
     def lane(self, name: str) -> Lane | None:
-        return self._lanes.get(name)
+        with self._cond:
+            return self._lanes.get(name)
 
     def lanes_snapshot(self) -> dict:
         """Point-in-time per-lane state for the incident flight recorder
@@ -420,7 +422,7 @@ class UnifiedScheduler:
 
     # --- the dispatch loop --------------------------------------------------
 
-    def _lane_ready(self, lane: Lane, now: float) -> bool:
+    def _lane_ready_locked(self, lane: Lane, now: float) -> bool:
         """The continuous-batching flush rule, per lane: dispatch when the
         batch is full, the linger expired, or we are draining for close.
         Deadline pressure also readies a lane early: once the effective
@@ -471,7 +473,7 @@ class UnifiedScheduler:
                     self._cond.wait()
                     continue
                 now = time.monotonic()
-                ready = [l for l in lanes if self._lane_ready(l, now)]
+                ready = [l for l in lanes if self._lane_ready_locked(l, now)]
                 if not ready:
                     # Sleep until the earliest linger/deadline readiness;
                     # new submits notify and re-evaluate sooner.

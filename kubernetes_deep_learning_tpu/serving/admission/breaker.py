@@ -62,11 +62,11 @@ class CircuitBreaker:
         )
         self._clock = clock
         self._lock = threading.Lock()
-        self.state = CLOSED
-        self._consecutive_failures = 0
-        self._opened_at = 0.0
-        self._probes_issued = 0
-        self._probe_successes = 0
+        self.state = CLOSED          # guarded-by: _lock
+        self._consecutive_failures = 0  # guarded-by: _lock
+        self._opened_at = 0.0        # guarded-by: _lock
+        self._probes_issued = 0      # guarded-by: _lock
+        self._probe_successes = 0    # guarded-by: _lock
 
     def allow(self) -> bool:
         """May a request go upstream right now?  HALF_OPEN consumes a probe

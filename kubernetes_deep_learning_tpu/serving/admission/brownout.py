@@ -130,10 +130,13 @@ class BrownoutController:
         ))
         self._clock = clock
         self._lock = threading.Lock()
+        # _stage is written only by the control loop (under _lock) and
+        # read lock-free by the hot-path gates: a single-int read racing
+        # one stage transition is equivalently ordered either way.
         self._stage = 0
-        self._last_burn = 0.0
-        self._last_transition_t: float | None = None
-        self.transitions: list[dict] = []
+        self._last_burn = 0.0        # guarded-by: _lock
+        self._last_transition_t: float | None = None  # guarded-by: _lock
+        self.transitions: list[dict] = []  # guarded-by: _lock
         self._m = (
             metrics_lib.brownout_metrics(registry)
             if registry is not None else None

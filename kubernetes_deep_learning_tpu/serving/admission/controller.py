@@ -128,13 +128,16 @@ class AdmissionController:
         self._class_m = metrics_lib.admission_class_metrics(self._tier_registry)
         # Per-model kdlt_admission_* slices (bounded `model` label, minted
         # centrally): lazily created per model name the handlers pass in.
-        self._model_m: dict[str, dict] = {}
+        self._model_m: dict[str, dict] = {}  # guarded-by: _model_m_lock
         self._model_m_lock = threading.Lock()
         if self._limiter is not None:
             self._m["limit"].set(self._limiter.limit)
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
-        self._inflight = 0
+        self._inflight = 0           # guarded-by: _lock
+        # Monotonic one-way flag (False -> True, never back): admit()
+        # reads it lock-free; a request racing the flip is equivalently
+        # ordered either way, so no lock is needed.
         self._draining = False
 
     @property
@@ -143,7 +146,8 @@ class AdmissionController:
 
     @property
     def inflight(self) -> int:
-        return self._inflight
+        with self._lock:
+            return self._inflight
 
     @property
     def limit(self) -> float | None:

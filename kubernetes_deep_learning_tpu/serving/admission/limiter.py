@@ -177,7 +177,7 @@ class AdaptiveLimiter:
         # between the two.  The explicit floor wins.
         self.max_limit = max(self.max_limit, self.min_limit)
         assert self.min_limit <= self.max_limit
-        self._limit = float(
+        self._limit = float(  # guarded-by: _cond
             initial if initial is not None
             else _env_float(INITIAL_CONCURRENCY_ENV, 8.0)
         )
@@ -203,31 +203,34 @@ class AdaptiveLimiter:
         self.queue_wait_fraction = queue_wait_fraction
         self._decrease = decrease
         self._cooldown_s = cooldown_s
-        self._last_decrease = 0.0
-        self._inflight = 0
-        self._inflight_by: dict[str, int] = {}
-        self._waiters: list[_Waiter] = []
+        self._last_decrease = 0.0    # guarded-by: _cond
+        self._inflight = 0           # guarded-by: _cond
+        self._inflight_by: dict[str, int] = {}  # guarded-by: _cond
+        self._waiters: list[_Waiter] = []  # guarded-by: _cond
         self._cond = threading.Condition()
         # Observed slot-hold EWMA (seconds held from admit to release), the
         # live backlog-drain estimate behind derived Retry-After hints.
-        self._hold_ewma_s = 0.0
+        self._hold_ewma_s = 0.0      # guarded-by: _cond
         self.budgets: dict[str, float] | None = (
             env_budgets() if budgets is _ENV_SENTINEL else budgets
         )
 
     @property
     def limit(self) -> float:
-        return self._limit
+        with self._cond:
+            return self._limit
 
     @property
     def inflight(self) -> int:
-        return self._inflight
+        with self._cond:
+            return self._inflight
 
     @property
     def queue_depth(self) -> int:
-        return len(self._waiters)
+        with self._cond:
+            return len(self._waiters)
 
-    def _slots_full(self) -> bool:
+    def _slots_full_locked(self) -> bool:
         return self._inflight >= max(1, int(self._limit))
 
     # --- per-model budget partitioning ---------------------------------
@@ -293,7 +296,7 @@ class AdaptiveLimiter:
 
     # --- queue arbitration ---------------------------------------------
 
-    def _grant_key(self, w: _Waiter) -> tuple:
+    def _grant_key_locked(self, w: _Waiter) -> tuple:
         # Under-share waiters first (the budget guarantee), then higher
         # class (lower rank), then FIFO.
         return (self._over_share_locked(w.model), w.rank, w.enq_t)
@@ -302,8 +305,8 @@ class AdaptiveLimiter:
         """Hand free slots to the best waiters; wakes every waiter whose
         state changed (granted or shed elsewhere)."""
         woke = False
-        while self._waiters and not self._slots_full():
-            w = min(self._waiters, key=self._grant_key)
+        while self._waiters and not self._slots_full_locked():
+            w = min(self._waiters, key=self._grant_key_locked)
             self._waiters.remove(w)
             w.granted = True
             self._take_slot_locked(w.model)
@@ -363,7 +366,7 @@ class AdaptiveLimiter:
         """
         rank = PRIORITY_RANK.get(priority, 0)
         with self._cond:
-            if not self._slots_full() and not self._waiters:
+            if not self._slots_full_locked() and not self._waiters:
                 # Free slot, empty queue: take it.  Work-conserving
                 # borrowing happens exactly here -- an over-share model may
                 # run on capacity nobody is waiting for; the budget bites

@@ -179,7 +179,7 @@ class SingleFlight:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._flights: dict[str, Flight] = {}
+        self._flights: dict[str, Flight] = {}  # guarded-by: _lock
 
     def begin(self, key: str) -> tuple[Flight, bool]:
         """Join or start the key's flight; returns (flight, is_leader)."""
@@ -262,18 +262,18 @@ class ResponseCache:
             MAX_MB_ENV, DEFAULT_MAX_MB
         )
         self.max_bytes = int(max_mb * 1024 * 1024)
-        self._entries: OrderedDict[str, _Entry] = OrderedDict()
-        self._bytes = 0
-        self._hashes: dict[str, str] = {}  # model -> resolved artifact hash
+        self._entries: OrderedDict[str, _Entry] = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0              # guarded-by: _lock
+        self._hashes: dict[str, str] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # Plain-int mirrors of the counters so /debug/cache works with or
         # without a registry (tests construct bare caches).
-        self.hits = 0
-        self.misses = 0
-        self.coalesced = 0
-        self.negative_hits = 0
-        self.stale_hits = 0
-        self.evictions: dict[str, int] = {
+        self.hits = 0                # guarded-by: _lock
+        self.misses = 0              # guarded-by: _lock
+        self.coalesced = 0           # guarded-by: _lock
+        self.negative_hits = 0       # guarded-by: _lock
+        self.stale_hits = 0          # guarded-by: _lock
+        self.evictions: dict[str, int] = {  # guarded-by: _lock
             reason: 0 for reason, _ in metrics_lib.CACHE_EVICTION_REASONS
         }
         self._m = (

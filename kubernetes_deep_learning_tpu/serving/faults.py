@@ -13,8 +13,9 @@ with ``KDLT_FAULTS_SEED`` (default 0) seeding the per-(point, kind) random
 streams, so the exact same request sequence sees the exact same faults on
 every run regardless of thread interleaving across points.
 
-Fault points are free-form names compiled into the serving path; the ones
-wired today (the fault matrix, GUIDE.md section 10e):
+Fault points are the ``FAULT_POINTS`` registry below -- the closed
+vocabulary of names compiled into the serving path (the fault matrix,
+GUIDE.md section 10e):
 
 ==================  =====================================================
 point               where it fires
@@ -29,6 +30,9 @@ point               where it fires
                       blocking device sync (a ``hang`` here is a wedged
                       device handle -- the watchdog's prey)
 ``grpc.predict``      the gRPC PredictionService unary shell
+``crosshost.broadcast`` the cross-host input broadcast, before the
+                      collective is issued
+``crosshost.collective`` the cross-host collective compute step
 ==================  =====================================================
 
 Kinds:
@@ -64,6 +68,22 @@ FAULTS_ENV = "KDLT_FAULTS"
 SEED_ENV = "KDLT_FAULTS_SEED"
 
 KINDS = ("error", "latency", "hang", "disconnect", "corrupt")
+
+# The closed vocabulary of fault points (see the module docstring's matrix
+# for where each fires).  Production ``fire()``/``corrupt()`` call sites
+# use these exact strings; kdlt-lint's closed-vocab pass enforces
+# membership statically, so a chaos experiment against a typo'd point
+# cannot silently "pass" by testing nothing.  parse_rules itself stays
+# permissive (tests inject at synthetic points).
+FAULT_POINTS = frozenset({
+    "gateway.upstream",
+    "server.predict",
+    "dispatch.submit",
+    "dispatch.complete",
+    "grpc.predict",
+    "crosshost.broadcast",
+    "crosshost.collective",
+})
 
 DEFAULT_LATENCY_MS = 100.0
 DEFAULT_HANG_S = 300.0

@@ -516,7 +516,7 @@ class Gateway:
                 return self._fetch_one_for(u, model)
         if trace is None:
             return fetch(url)
-        with trace.span("gateway.preprocess"):
+        with trace.span(trace_lib.SPAN_GATEWAY_PREPROCESS):
             return fetch(url)
 
     def _validate_replica_spec(self, replica, model: str | None = None) -> None:
@@ -601,13 +601,13 @@ class Gateway:
             )
         except Exception as e:
             trace.tracer.record(
-                trace.trace_id, "gateway.upstream", w0,
+                trace.trace_id, trace_lib.SPAN_GATEWAY_UPSTREAM, w0,
                 trace_lib.now_s() - w0, parent_id=trace.span_id, span_id=sid,
                 replica=replica.host, role=role, error=str(e)[:120],
             )
             raise
         span = trace.tracer.record(
-            trace.trace_id, "gateway.upstream", w0, trace_lib.now_s() - w0,
+            trace.trace_id, trace_lib.SPAN_GATEWAY_UPSTREAM, w0, trace_lib.now_s() - w0,
             parent_id=trace.span_id, span_id=sid,
             replica=replica.host, role=role, status=r.status_code,
         )
@@ -960,7 +960,7 @@ class Gateway:
                     timeout=None if deadline is None else deadline.remaining_s(),
                 )
             else:
-                with trace.span("gateway.microbatch"):
+                with trace.span(trace_lib.SPAN_GATEWAY_MICROBATCH):
                     row, labels = microbatcher.predict(
                         image,
                         request_id,
@@ -1349,7 +1349,7 @@ class Gateway:
             if hit_status != 200:
                 self._m_errors.inc()
             self.tracer.record(
-                rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                rid, trace_lib.SPAN_GATEWAY_CACHE, w0, trace_lib.now_s() - w0,
                 parent_id=rt.span_id, result=disposition, status=hit_status,
             )
             return hit_status, out, ctype, {
@@ -1373,7 +1373,7 @@ class Gateway:
                 self._m_errors.inc()
                 self.admission.count_shed("deadline_exhausted", priority)
                 self.tracer.record(
-                    rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                    rid, trace_lib.SPAN_GATEWAY_CACHE, w0, trace_lib.now_s() - w0,
                     parent_id=rt.span_id, result="coalesced", outcome="timeout",
                 )
                 return 504, json.dumps(
@@ -1385,7 +1385,7 @@ class Gateway:
             except BaseException as e:  # noqa: BLE001 - leader died unmapped
                 self._m_errors.inc()
                 self.tracer.record(
-                    rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                    rid, trace_lib.SPAN_GATEWAY_CACHE, w0, trace_lib.now_s() - w0,
                     parent_id=rt.span_id, result="coalesced",
                     error=str(e)[:120],
                 )
@@ -1397,7 +1397,7 @@ class Gateway:
             if status >= 400:
                 self._m_errors.inc()  # every follower answers its own client
             self.tracer.record(
-                rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+                rid, trace_lib.SPAN_GATEWAY_CACHE, w0, trace_lib.now_s() - w0,
                 parent_id=rt.span_id, result="coalesced", status=status,
             )
             return status, out, ctype, {
@@ -1408,7 +1408,7 @@ class Gateway:
         # upstream attempts) follow in this same trace.
         self.cache.count_miss()
         self.tracer.record(
-            rid, "gateway.cache", w0, trace_lib.now_s() - w0,
+            rid, trace_lib.SPAN_GATEWAY_CACHE, w0, trace_lib.now_s() - w0,
             parent_id=rt.span_id, result="miss",
         )
         try:
@@ -1470,7 +1470,7 @@ class Gateway:
         n_urls = 1
         try:
             try:
-                with rt.span("gateway.admission"):
+                with rt.span(trace_lib.SPAN_GATEWAY_ADMISSION):
                     ticket = self.admission.admit(
                         deadline, model=routed,
                         priority=priority or protocol.DEFAULT_PRIORITY,
@@ -1642,7 +1642,7 @@ class Gateway:
             # build the X-Kdlt-Trace header AFTER handle_predict returns,
             # so the header summary includes it.
             self.tracer.record(
-                rid, "gateway.request", w_start, trace_lib.now_s() - w_start,
+                rid, trace_lib.SPAN_GATEWAY_REQUEST, w_start, trace_lib.now_s() - w_start,
                 span_id=rt.span_id, status=status, urls=n_urls,
             )
             self.tracer.classify(

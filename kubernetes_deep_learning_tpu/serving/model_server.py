@@ -284,7 +284,7 @@ class ServedModel:
                 pass
         if images.shape[0] <= max_b:
             if trace is not None:
-                with trace.span("engine.predict", batch=int(images.shape[0])):
+                with trace.span(trace_lib.SPAN_ENGINE_PREDICT, batch=int(images.shape[0])):
                     return self.engine.predict(images)
             return self.engine.predict(images)
         # Batches beyond the bucket ladder are served in max-bucket chunks
@@ -848,7 +848,7 @@ class ModelServer:
                     # Admission BEFORE the body is read or decoded: an
                     # exhausted or shed request must cost no decode work and
                     # never touch the TPU.
-                    with rt.span("server.admission"):
+                    with rt.span(trace_lib.SPAN_SERVER_ADMISSION):
                         ticket = server.admission.admit(
                             deadline, model=m.group(1), priority=priority
                         )
@@ -880,7 +880,7 @@ class ModelServer:
                             f"{limit}-byte limit "
                             f"({MAX_IMAGES_PER_REQUEST}-image cap)"
                         )
-                    with rt.span("server.decode", bytes=length):
+                    with rt.span(trace_lib.SPAN_SERVER_DECODE, bytes=length):
                         body = self.rfile.read(length)
                         self._body_consumed = True
                         ctype = self.headers.get("Content-Type", "")
@@ -897,7 +897,7 @@ class ModelServer:
                             f"{MAX_IMAGES_PER_REQUEST}-image request limit"
                         )
                     batch = images.shape[0]
-                    with rt.span("server.predict", batch=batch) as pt:
+                    with rt.span(trace_lib.SPAN_SERVER_PREDICT, batch=batch) as pt:
                         logits = model.predict(
                             images, deadline=deadline, trace=pt,
                             priority=priority,
@@ -1025,7 +1025,7 @@ class ModelServer:
                     # which is why the X-Kdlt-Trace header carries only the
                     # sub-spans while /debug/trace/<rid> has everything.
                     server.tracer.record(
-                        rid, "server.request", w_start,
+                        rid, trace_lib.SPAN_SERVER_REQUEST, w_start,
                         trace_lib.now_s() - w_start,
                         parent_id=parent, span_id=rt.span_id,
                         status=status, batch=batch,

@@ -340,11 +340,11 @@ class UpstreamPool:
         self.reference_specs: dict[str, object] = {}
         # Departed replicas' discovered contracts, keyed by host (bounded):
         # a DNS flap that re-adds an endpoint restores its spec cache.
-        self._spec_memo: dict[str, tuple] = {}
-        self.joins = 0
-        self.leaves = 0
+        self._spec_memo: dict[str, tuple] = {}  # guarded-by: _lock
+        self.joins = 0               # guarded-by: _lock
+        self.leaves = 0              # guarded-by: _lock
         self._lock = threading.Lock()
-        self._rr = 0
+        self._rr = 0                 # guarded-by: _lock
         m = (
             metrics_lib.upstream_pool_metrics(registry)
             if registry is not None
@@ -714,7 +714,8 @@ class UpstreamPool:
                 status = get_status(f"{r.base}/readyz")
                 if r.draining:
                     if status == 200:
-                        r.draining = False
+                        with self._lock:
+                            r.draining = False
                     elif status is None:
                         # The draining process is gone: hand recovery to
                         # the unhealthy//healthz path.
@@ -725,7 +726,8 @@ class UpstreamPool:
                             "pool.unhealthy", host=r.host, reason="drain_dead"
                         )
                 elif status is not None and status != 200:
-                    r.draining = True
+                    with self._lock:
+                        r.draining = True
                     _log.info(
                         "replica %s readyz=%d: draining (no new primaries)",
                         r.host, status,
@@ -738,14 +740,16 @@ class UpstreamPool:
         """The /debug/pool document: membership + per-replica selection
         state (what ``kdlt-client --stats`` renders per replica)."""
         reps = list(self.replicas)
+        with self._lock:
+            joins, leaves = self.joins, self.leaves
         return {
             "failover": self.failover,
             "hedge_delay_ms": self.hedge_delay_s * 1e3,
             "probe_interval_s": self.probe_interval_s,
             "resolve_interval_s": self.resolve_interval_s,
             "members": len(reps),
-            "joins": self.joins,
-            "leaves": self.leaves,
+            "joins": joins,
+            "leaves": leaves,
             "replicas": [
                 {
                     "host": r.host,

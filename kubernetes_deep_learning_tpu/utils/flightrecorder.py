@@ -234,29 +234,35 @@ class FlightRecorder:
         self._clock = clock
         self._wall = wall
         self.bundle_events = int(bundle_events)
-        self._ring: collections.deque = collections.deque(
+        self._ring: collections.deque = collections.deque(  # guarded-by: _ring_lock
             maxlen=int(ring_events)
         )
         self._ring_lock = threading.Lock()
         self._trig_lock = threading.Lock()
         self._index_lock = threading.Lock()
-        self._index: list = []       # chronological bundle summaries
-        self._bundles: dict = {}     # id -> full bundle (memory mirror)
-        self._seq = 0
+        self._index: list = []       # guarded-by: _index_lock
+        self._bundles: dict = {}     # guarded-by: _index_lock
+        self._seq = 0                # guarded-by: _index_lock
         self._registry = registry
+        # _last_metrics is touched only by _metrics_delta on the capture
+        # worker thread (single consumer); no lock needed.
         self._last_metrics: dict | None = None
-        self._shed_seen = 0
-        self._shed_mark = 0
-        self._last_burn: float | None = None
+        self._shed_seen = 0          # guarded-by: _trig_lock
+        self._shed_mark = 0          # guarded-by: _trig_lock
+        self._last_burn: float | None = None  # guarded-by: _trig_lock
         self._m = (
             metrics_lib.incident_metrics(registry)
             if registry is not None else None
         )
         self._queue: queue.Queue = queue.Queue(maxsize=16)
-        self._worker: threading.Thread | None = None
-        self._pending = 0
+        self._worker: threading.Thread | None = None  # guarded-by: _idle
+        self._pending = 0            # guarded-by: _idle
         self._idle = threading.Condition()
-        self._closed = False
+        self._closed = False         # guarded-by: _idle
+        # Snapshot providers: name -> zero-arg callable returning the same
+        # JSON the matching /debug/<name> endpoint serves.  Registered by
+        # the owning tier at construction time, read-only afterwards.
+        self._providers: dict = {}
         if self.enabled and self.incident_dir:
             self._reindex_dir()
 
@@ -297,7 +303,8 @@ class FlightRecorder:
         if not self.enabled:
             return
         thr = self.trigger_threshold("burn-crossing", 1.0)
-        prev, self._last_burn = self._last_burn, burn
+        with self._trig_lock:
+            prev, self._last_burn = self._last_burn, burn
         if prev is None:
             return
         if prev < thr <= burn:
@@ -315,13 +322,15 @@ class FlightRecorder:
         """O(1) shed tick from admission hot paths; tick_shed_burst turns
         the per-tick delta into at most one shed.burst event."""
         if self.enabled:
-            self._shed_seen += 1
+            with self._trig_lock:
+                self._shed_seen += 1
 
     def tick_shed_burst(self, min_burst: int = 10) -> None:
         if not self.enabled:
             return
-        seen = self._shed_seen
-        delta, self._shed_mark = seen - self._shed_mark, seen
+        with self._trig_lock:
+            seen = self._shed_seen
+            delta, self._shed_mark = seen - self._shed_mark, seen
         if delta >= min_burst:
             self.record("shed.burst", count=delta)
 
@@ -381,12 +390,9 @@ class FlightRecorder:
 
     # --- bundle capture ----------------------------------------------------
 
-    # Snapshot providers: name -> zero-arg callable returning the same
-    # JSON the matching /debug/<name> endpoint serves.  Registered by the
-    # owning tier at construction time.
     def add_snapshot_provider(self, name: str, fn) -> None:
-        if not hasattr(self, "_providers"):
-            self._providers = {}
+        """Register a /debug/<name>-shaped snapshot callable (construction
+        time only; see the _providers declaration in __init__)."""
         self._providers[name] = fn
 
     def _enqueue_capture(self, trigger: str, ev: dict) -> None:
@@ -410,6 +416,7 @@ class FlightRecorder:
                 if c is not None:
                     c.inc()
             return
+        # kdlt-lint: disable=guarded-by -- double-checked fast path: the unlocked read only skips the lock when a worker already exists; creation re-checks under _idle
         if self._worker is None:
             with self._idle:
                 if self._worker is None and not self._closed:
@@ -464,7 +471,7 @@ class FlightRecorder:
             "traces": {},
             "metrics_delta": self._metrics_delta(),
         }
-        for name, fn in getattr(self, "_providers", {}).items():
+        for name, fn in self._providers.items():
             try:
                 bundle["snapshots"][name] = fn()
             except Exception as e:  # noqa: BLE001 - a broken provider must
@@ -563,6 +570,7 @@ class FlightRecorder:
             names = sorted(os.listdir(self.incident_dir))
         except OSError:
             return
+        adopted: list = []
         for name in names:
             if not (name.startswith("inc-") and name.endswith(".json")):
                 continue
@@ -573,7 +581,7 @@ class FlightRecorder:
                 size = os.path.getsize(path)
             except (OSError, ValueError):
                 continue
-            self._index.append({
+            adopted.append({
                 "id": bundle.get("id", name[:-5]),
                 "tier": bundle.get("tier"),
                 "trigger": bundle.get("trigger"),
@@ -584,8 +592,9 @@ class FlightRecorder:
                 "traces": sorted(bundle.get("traces", {})),
                 "bytes": size, "path": path,
             })
-        self._index.sort(key=lambda e: e.get("fired_at_s") or 0.0)
         with self._index_lock:
+            self._index.extend(adopted)
+            self._index.sort(key=lambda e: e.get("fired_at_s") or 0.0)
             self._evict_locked()
             if self._m is not None:
                 self._m["open"].set(len(self._index))

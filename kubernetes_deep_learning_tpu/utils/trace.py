@@ -48,6 +48,57 @@ GRPC_PARENT_SPAN_KEY = "x-kdlt-parent-span"  # gRPC metadata keys are lowercase
 
 _SPAN_ID_RE = re.compile(r"[^A-Za-z0-9]")
 
+# --- span-name vocabulary ---------------------------------------------------
+# The single source of truth for every span name the tree records.  The
+# waterfall renderers, the Server-Timing summary header, and the trace
+# tooling all key on these exact strings, so the set is CLOSED: recording
+# sites use these constants (or a literal that is a member -- enforced
+# statically by kdlt-lint's closed-vocab pass), and adding a span means
+# adding it here first.
+SPAN_GATEWAY_REQUEST = "gateway.request"
+SPAN_GATEWAY_ADMISSION = "gateway.admission"
+SPAN_GATEWAY_PREPROCESS = "gateway.preprocess"
+SPAN_GATEWAY_MICROBATCH = "gateway.microbatch"
+SPAN_GATEWAY_CACHE = "gateway.cache"
+SPAN_GATEWAY_UPSTREAM = "gateway.upstream"
+SPAN_SERVER_REQUEST = "server.request"
+SPAN_SERVER_ADMISSION = "server.admission"
+SPAN_SERVER_DECODE = "server.decode"
+SPAN_SERVER_PREDICT = "server.predict"
+SPAN_ENGINE_PREDICT = "engine.predict"
+SPAN_BATCHER_QUEUE_WAIT = "batcher.queue_wait"
+SPAN_BATCHER_WAIT = "batcher.wait"
+SPAN_PIPELINE_ENQUEUE_WAIT = "pipeline.enqueue_wait"
+SPAN_PIPELINE_DISPATCH = "pipeline.dispatch"
+SPAN_PIPELINE_EXECUTE = "pipeline.execute"
+SPAN_PIPELINE_READBACK = "pipeline.readback"
+SPAN_CROSSHOST_BROADCAST = "crosshost.broadcast"
+SPAN_CROSSHOST_COLLECTIVE = "crosshost.collective"
+SPAN_CROSSHOST_GATHER = "crosshost.gather"
+
+SPAN_NAMES = frozenset({
+    SPAN_GATEWAY_REQUEST,
+    SPAN_GATEWAY_ADMISSION,
+    SPAN_GATEWAY_PREPROCESS,
+    SPAN_GATEWAY_MICROBATCH,
+    SPAN_GATEWAY_CACHE,
+    SPAN_GATEWAY_UPSTREAM,
+    SPAN_SERVER_REQUEST,
+    SPAN_SERVER_ADMISSION,
+    SPAN_SERVER_DECODE,
+    SPAN_SERVER_PREDICT,
+    SPAN_ENGINE_PREDICT,
+    SPAN_BATCHER_QUEUE_WAIT,
+    SPAN_BATCHER_WAIT,
+    SPAN_PIPELINE_ENQUEUE_WAIT,
+    SPAN_PIPELINE_DISPATCH,
+    SPAN_PIPELINE_EXECUTE,
+    SPAN_PIPELINE_READBACK,
+    SPAN_CROSSHOST_BROADCAST,
+    SPAN_CROSSHOST_COLLECTIVE,
+    SPAN_CROSSHOST_GATHER,
+})
+
 # One wall-anchored monotonic clock per process: perf_counter deltas on a
 # wall-time anchor.  time.time() alone can step (NTP) mid-request, which
 # would fabricate overlapping/negative child intervals.

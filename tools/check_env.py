@@ -1,200 +1,81 @@
 #!/usr/bin/env python
-"""Static env-knob lint: every ``KDLT_*`` variable the tree reads is
-documented, and the deploy manifests that mirror each serving tier agree.
+"""Env-knob lint CLI -- a thin shim over kdlt-lint's env pass.
 
-Env vars are the repo's operational API -- every knob in GUIDE.md's worked
-runs, the compose file, and the k8s manifests is one.  Two failure modes
-creep in as the tree grows: a module growing a knob nobody documents (the
-operator discovers it by reading source, or never), and the compose /
-k8s mirrors of a tier drifting apart (a replica pair that disagrees on
-KDLT_SCHED_POLICY serves two latency profiles; a compose gateway without
-the k8s gateway's cache knobs behaves differently in the only environment
-most contributors test in).  This lint catches both statically.  Wired
-into tier-1 via tests/test_check_env.py.
-
-Rules:
-
-- every string literal in production code (the package + bench.py) that
-  IS an env-var name -- a whole-string match of ``KDLT_[A-Z0-9_]+`` --
-  must appear somewhere in GUIDE.md.  Scanning literals rather than
-  ``os.environ`` call sites is deliberate: the tree's idiom is
-  ``FOO_ENV = "KDLT_FOO"`` constants passed through helpers, and a
-  reference-only literal that never reaches a read is vanishingly rare
-  next to the drift this catches;
-- every ``KDLT_*`` key in a deploy manifest must be a name production
-  code actually reads (catches manifest typos: a misspelled knob is
-  silently default-valued at runtime);
-- the two compose model-tier replicas must set IDENTICAL ``KDLT_*`` maps
-  (the gateway fails over between them: any disagreement is a latency /
-  behavior split);
-- for each tier, the compose services and the k8s manifest must set the
-  same ``KDLT_*`` keys with the same values, except:
-  - ``ALLOW_VALUE_DRIFT`` keys may differ in value (host-ish: compose
-    service names vs cluster DNS),
-  - ``ALLOW_PRESENCE_DRIFT`` keys may be absent on one side (path-ish
-    knobs tied to a volume only one environment mounts).
+The rules (every whole-string ``KDLT_*`` literal documented in GUIDE.md,
+deploy-manifest keys read by code, the compose replica pair identical,
+compose/k8s tier mirrors agreeing modulo the declared drift allowances)
+now live in tools/kdlt_lint/passes/env_knobs.py, where they run as one
+pass of the unified suite alongside lock-discipline, hot-path-sync,
+donation-safety and closed-vocab.  The drift allowances themselves moved
+into that pass's DEPLOY_AGREEMENT declarative config; this shim re-exports
+them plus the ``env_literals``/``compose_env``/``k8s_env`` helpers
+(tests/test_check_env.py exercises each directly) so nothing keyed on
+``check_env`` breaks.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = "kubernetes_deep_learning_tpu"
-EXTRA_FILES = ("bench.py",)
-GUIDE = "GUIDE.md"
-SKIP_PARTS = {"tfs_gen", "__pycache__"}
-ENV_RE = re.compile(r"KDLT_[A-Z0-9_]+\Z")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-COMPOSE = os.path.join("deploy", "docker-compose.yaml")
-K8S_GATEWAY = os.path.join("deploy", "k8s", "gateway-deployment.yaml")
-K8S_MODEL = os.path.join("deploy", "k8s", "model-server-deployment.yaml")
-
-# Tier mirrors: (tier name, compose service names, k8s manifest).
-TIERS = (
-    ("gateway", ("gateway",), K8S_GATEWAY),
-    ("model-server", ("model-server", "model-server-b"), K8S_MODEL),
+from kdlt_lint.core import (  # noqa: E402,F401
+    EXTRA_FILES,
+    PACKAGE,
+    REPO,
+    SKIP_PARTS,
+    LintContext,
+    ModuleInfo,
+    iter_production_files as _iter_files,
+)
+from kdlt_lint.passes.env_knobs import (  # noqa: E402,F401
+    COMPOSE,
+    DEPLOY_AGREEMENT,
+    ENV_RE,
+    GUIDE,
+    K8S_GATEWAY,
+    K8S_MODEL,
+    EnvKnobsPass,
+    compose_env,
+    env_literals,
+    k8s_env,
 )
 
-# Host-ish knobs: the VALUE legitimately differs between compose (service
-# names on the compose network) and k8s (cluster DNS).
-ALLOW_VALUE_DRIFT = {"KDLT_SERVING_HOST"}
-# Path-ish knobs tied to a volume/filesystem only one environment mounts;
-# presence on one side only is fine.
-ALLOW_PRESENCE_DRIFT = {"KDLT_COMPILE_CACHE_DIR", "KDLT_PROFILE_DIR"}
+# Back-compat views of the pass's declarative config.
+TIERS = DEPLOY_AGREEMENT["tiers"]
+ALLOW_VALUE_DRIFT = set(DEPLOY_AGREEMENT["allow_value_drift"])
+ALLOW_PRESENCE_DRIFT = set(DEPLOY_AGREEMENT["allow_presence_drift"])
 
 
 def iter_production_files() -> list[str]:
-    files: list[str] = [os.path.join(REPO, f) for f in EXTRA_FILES]
-    for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, PACKAGE)):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_PARTS]
-        files.extend(
-            os.path.join(dirpath, f) for f in sorted(filenames)
-            if f.endswith(".py")
-        )
-    return files
-
-
-def env_literals(src: str, rel: str) -> dict[str, int]:
-    """Whole-string KDLT_* literals in a module -> first line seen."""
-    found: dict[str, int] = {}
-    for node in ast.walk(ast.parse(src, filename=rel)):
-        if (
-            isinstance(node, ast.Constant)
-            and isinstance(node.value, str)
-            and ENV_RE.match(node.value)
-        ):
-            found.setdefault(node.value, node.lineno)
-    return found
-
-
-def compose_env(doc: dict, service: str) -> dict[str, str]:
-    svc = (doc.get("services") or {}).get(service) or {}
-    env = svc.get("environment") or {}
-    if isinstance(env, list):  # compose also allows ["K=V", ...]
-        env = dict(item.split("=", 1) for item in env)
-    return {k: str(v) for k, v in env.items() if k.startswith("KDLT_")}
-
-
-def k8s_env(doc: dict) -> dict[str, str]:
-    tmpl = doc.get("spec", {}).get("template", {}).get("spec", {})
-    out: dict[str, str] = {}
-    for container in tmpl.get("containers") or []:
-        for item in container.get("env") or []:
-            name = item.get("name", "")
-            if name.startswith("KDLT_"):
-                out[name] = str(item.get("value", ""))
-    return out
+    return _iter_files(REPO)
 
 
 def main() -> int:
     violations: list[str] = []
-
-    # 1. Every env literal in production code is documented in GUIDE.md.
-    code_envs: dict[str, str] = {}  # name -> "rel:line" of first sighting
+    env_pass = EnvKnobsPass()
+    ctx = LintContext(REPO)
     for path in iter_production_files():
-        rel = os.path.relpath(path, REPO)
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
         with open(path) as f:
-            try:
-                for name, line in env_literals(f.read(), rel).items():
-                    code_envs.setdefault(name, f"{rel}:{line}")
-            except SyntaxError as e:
-                violations.append(f"{rel}: unparsable: {e}")
-    with open(os.path.join(REPO, GUIDE)) as f:
-        guide_text = f.read()
-    for name in sorted(code_envs):
-        if name not in guide_text:
-            violations.append(
-                f"{code_envs[name]}: {name} is read by production code but "
-                f"never mentioned in {GUIDE}; document the knob"
-            )
-
-    # 2+3+4. Deploy manifests: keys exist in code, mirrors agree.
-    import yaml
-
-    with open(os.path.join(REPO, COMPOSE)) as f:
-        compose_doc = yaml.safe_load(f)
-    k8s_docs = {}
-    for manifest in (K8S_GATEWAY, K8S_MODEL):
-        with open(os.path.join(REPO, manifest)) as f:
-            k8s_docs[manifest] = yaml.safe_load(f)
-
-    deploy_maps: list[tuple[str, dict[str, str]]] = []
-    for tier, services, manifest in TIERS:
-        for svc in services:
-            deploy_maps.append(
-                (f"{COMPOSE}:{svc}", compose_env(compose_doc, svc))
-            )
-        deploy_maps.append((manifest, k8s_env(k8s_docs[manifest])))
-    for where, env in deploy_maps:
-        for name in sorted(env):
-            if name not in code_envs:
-                violations.append(
-                    f"{where}: {name} is set but no production code reads "
-                    "it (typo'd knob names are silently ignored at runtime)"
-                )
-
-    # Compose replica pair: identical maps, no exceptions.
-    pair = [compose_env(compose_doc, s) for s in ("model-server", "model-server-b")]
-    if pair[0] != pair[1]:
-        diff = sorted(
-            set(pair[0].items()) ^ set(pair[1].items())
-        )
-        violations.append(
-            f"{COMPOSE}: model-server and model-server-b disagree on "
-            f"{sorted({k for k, _ in diff})}; the gateway fails over "
-            "between them, so their KDLT_* maps must be identical"
-        )
-
-    # Cross-environment tier mirrors.
-    for tier, services, manifest in TIERS:
-        c_env = compose_env(compose_doc, services[0])
-        k_env = k8s_env(k8s_docs[manifest])
-        for name in sorted(set(c_env) | set(k_env)):
-            if name in ALLOW_PRESENCE_DRIFT:
-                continue
-            if name not in c_env or name not in k_env:
-                missing = COMPOSE if name not in c_env else manifest
-                violations.append(
-                    f"{tier}: {name} is wired in one environment but "
-                    f"missing from {missing}; compose and k8s mirrors of "
-                    "a tier must set the same knobs"
-                )
-            elif name not in ALLOW_VALUE_DRIFT and c_env[name] != k_env[name]:
-                violations.append(
-                    f"{tier}: {name} disagrees between {COMPOSE} "
-                    f"({c_env[name]!r}) and {manifest} ({k_env[name]!r})"
-                )
-
+            src = f.read()
+        try:
+            mod = ModuleInfo(rel, src)
+        except SyntaxError as e:
+            violations.append(f"{rel}: unparsable: {e}")
+            continue
+        env_pass.check_module(mod, ctx)
+    for f in env_pass.finalize(ctx):
+        # Manifest-level findings (line 0) already carry their location in
+        # the message; code-level ones get the classic rel:line prefix.
+        violations.append(f"{f.rel}:{f.line}: {f.message}" if f.line else f.message)
     for v in violations:
         print(v)
     if not violations:
         print(
-            f"check_env: {len(code_envs)} KDLT_* knobs documented; deploy "
-            "mirrors agree"
+            f"check_env: {ctx.scratch.get('env.knob_count', 0)} KDLT_* knobs "
+            "documented; deploy mirrors agree"
         )
     return 1 if violations else 0
 

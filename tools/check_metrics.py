@@ -1,232 +1,52 @@
 #!/usr/bin/env python
-"""Static metrics-naming lint: every series is kdlt_-prefixed and minted
-through the central helpers in utils/metrics.py.
+"""Metrics-naming lint CLI -- a thin shim over kdlt-lint's metrics pass.
 
-The /metrics pages are the operational contract of both serving tiers;
-dashboards and alerts key on series names.  Two failure modes creep in as
-the tree grows: a module minting an un-prefixed name (invisible to every
-``kdlt_``-scoped dashboard query), and a module constructing Counter/
-Gauge/Histogram objects directly instead of going through a Registry or
-the helper functions (its series silently never reach /metrics, or reach
-it unlabeled).  This lint walks the AST of every production module and
-flags both.  Wired into tier-1 via tests/test_check_metrics.py.
-
-Rules (production code only; tests/, exp/, tfs_gen/ are exempt):
-
-- every ``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")``
-  call must pass a string (or f-string with a literal head) starting with
-  ``kdlt_`` -- dynamic names with non-literal heads are flagged too, since
-  they cannot be audited statically;
-- Counter/Gauge/Histogram must not be instantiated directly outside
-  utils/metrics.py (the Registry mint methods are the only sanctioned
-  constructors -- they dedupe, label, and register);
-- the ``model`` label must be minted centrally: ``.with_labels(model=...)``
-  outside utils/metrics.py is flagged -- modules attach the label through
-  utils.metrics.model_registry / model_version_registry and friends, which
-  is what keeps its cardinality BOUNDED (MODEL_LABEL_CAP + the overflow
-  bucket) no matter what names a caller feeds in.  The same rule covers
-  the other bounded labels: ``window`` (the SLO engine's fixed window set),
-  ``class`` (the tracer's retention classes), ``reason`` (cache eviction
-  reasons), ``scheme`` (the quantization scheme list), ``source`` (the
-  warmup provenance pair), and ``trigger`` (the flight recorder's fixed
-  trigger-rule names);
-- ``kdlt_slo_*`` series must be minted inside utils/metrics.py: the SLO
-  engine's gauge matrix is (bounded model) x (fixed window), and a module
-  minting its own slice would bypass both bounds at once;
-- ``exemplar=`` is histogram-only (the OpenMetrics rule): passing it to a
-  counter/gauge mutation (``.inc()``/``.set()``) is flagged -- at runtime
-  it would TypeError, but the lint catches it before a request does.
+The rules (every series kdlt_-prefixed and minted through the central
+helpers in utils/metrics.py; bounded labels and the central prefixes
+confined to that module; exemplars histogram-only) now live in
+tools/kdlt_lint/passes/metrics_names.py, where they run as one pass of
+the unified suite alongside lock-discipline, hot-path-sync, donation-
+safety and closed-vocab.  This shim keeps the original CLI and the
+``lint_source(src, rel)`` API (tests/test_check_metrics.py asserts on its
+exact message strings) so nothing keyed on ``check_metrics`` breaks.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = "kubernetes_deep_learning_tpu"
-EXTRA_FILES = ("bench.py",)
-METRIC_PREFIX = "kdlt_"
-MINT_METHODS = {"counter", "gauge", "histogram"}
-METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
-# Labels whose value sets are bounded by construction inside utils/metrics.py
-# (model: MODEL_LABEL_CAP + overflow; window: the SLO window list; class:
-# the trace retention classes; reason: the cache eviction reasons; scheme:
-# the quantization scheme list; source: the warmup provenance pair;
-# stage/direction: the brownout ladder's four stages and two directions;
-# trigger: the flight recorder's fixed trigger-rule names) -- attaching
-# them anywhere else escapes the bound.
-CENTRAL_LABELS = {
-    "model", "window", "class", "reason", "scheme", "source",
-    "stage", "direction", "trigger",
-}
-# Series prefixes whose minting is confined to utils/metrics.py even beyond
-# the general helper conventions (the SLO gauge matrix, the response
-# cache's series, the quantization scheme/gate series, the dynamic-
-# membership pool series, and the flight recorder's incident series: all
-# carry bounded labels a stray mint would escape).
-CENTRAL_PREFIXES = (
-    "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
-    "kdlt_incident_",
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kdlt_lint.core import ModuleInfo, LintContext  # noqa: E402
+from kdlt_lint.passes.metrics_names import (  # noqa: E402,F401
+    CENTRAL_LABELS,
+    CENTRAL_NAMES,
+    CENTRAL_PREFIXES,
+    METRIC_CLASSES,
+    METRIC_PREFIX,
+    METRICS_MODULE,
+    MINT_METHODS,
+    MetricsNamingPass,
 )
-# Exact series names likewise confined to utils/metrics.py: these live
-# under prefixes too broad to confine wholesale (kdlt_engine_* is minted
-# per-engine in runtime/engine.py) but carry a bounded label.
-CENTRAL_NAMES = ("kdlt_engine_warm_source",)
-METRICS_MODULE = f"{PACKAGE}.utils.metrics"
-SKIP_PARTS = {"tfs_gen", "__pycache__"}
-
-
-def _literal_head(node: ast.expr) -> str | None:
-    """The statically-known head of a name argument: the whole string for
-    a constant, the leading constant of an f-string, else None."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    if isinstance(node, ast.JoinedStr) and node.values:
-        head = node.values[0]
-        if isinstance(head, ast.Constant) and isinstance(head.value, str):
-            return head.value
-    return None
-
-
-def _name_arg(call: ast.Call) -> ast.expr | None:
-    if call.args:
-        return call.args[0]
-    for kw in call.keywords:
-        if kw.arg == "name":
-            return kw.value
-    return None
+from kdlt_lint.core import (  # noqa: E402,F401
+    EXTRA_FILES,
+    PACKAGE,
+    REPO,
+    SKIP_PARTS,
+    iter_production_files as _iter_files,
+)
 
 
 def lint_source(src: str, rel: str) -> list[str]:
     """Lint one module's source; returns violation strings."""
-    violations: list[str] = []
-    tree = ast.parse(src, filename=rel)
-    # Aliases under which this module can reach the metric classes.
-    metrics_module_aliases: set[str] = set()
-    metric_class_aliases: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == METRICS_MODULE:
-                    metrics_module_aliases.add((a.asname or a.name).split(".")[0])
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == METRICS_MODULE.rsplit(".", 1)[0]:
-                for a in node.names:
-                    if a.name == "metrics":
-                        metrics_module_aliases.add(a.asname or a.name)
-            elif node.module == METRICS_MODULE:
-                for a in node.names:
-                    if a.name in METRIC_CLASSES:
-                        metric_class_aliases.add(a.asname or a.name)
-
-    is_metrics_module = rel.replace(os.sep, "/").endswith("utils/metrics.py")
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        # Direct Counter/Gauge/Histogram construction outside the central
-        # module (via `from ..utils.metrics import Histogram` or
-        # `metrics_lib.Histogram(...)`).
-        if not is_metrics_module and (
-            (isinstance(fn, ast.Name) and fn.id in metric_class_aliases)
-            or (
-                isinstance(fn, ast.Attribute)
-                and fn.attr in METRIC_CLASSES
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id in metrics_module_aliases
-            )
-        ):
-            cls = fn.id if isinstance(fn, ast.Name) else fn.attr
-            violations.append(
-                f"{rel}:{node.lineno}: direct {cls}(...) construction; mint "
-                "through a Registry / the utils.metrics helpers instead"
-            )
-            continue
-        # The bounded labels: with_labels(model=.../window=.../class=...)
-        # may only happen inside the central module (model_registry, the
-        # slo/retention helpers); anywhere else it bypasses the cardinality
-        # caps and the memoized dedupe.  Keyword "class" also arrives as
-        # with_labels(**{"class": ...}) -- a dict-literal double-star with
-        # a matching constant key counts too.
-        if (
-            not is_metrics_module
-            and isinstance(fn, ast.Attribute)
-            and fn.attr == "with_labels"
-        ):
-            bounded = {
-                kw.arg for kw in node.keywords if kw.arg in CENTRAL_LABELS
-            }
-            for kw in node.keywords:
-                if kw.arg is None and isinstance(kw.value, ast.Dict):
-                    bounded.update(
-                        k.value for k in kw.value.keys
-                        if isinstance(k, ast.Constant)
-                        and k.value in CENTRAL_LABELS
-                    )
-            if bounded:
-                labels = ", ".join(sorted(bounded))
-                violations.append(
-                    f"{rel}:{node.lineno}: .with_labels({labels}=...) outside "
-                    "utils/metrics.py; mint bounded labels through the "
-                    "central helpers (model_registry / "
-                    "slo_model_window_metrics / trace_retention_metrics)"
-                )
-                continue
-        # Exemplars are a histogram concept (OpenMetrics): counter/gauge
-        # mutations must not carry one.
-        if (
-            isinstance(fn, ast.Attribute)
-            and fn.attr in ("inc", "set")
-            and any(kw.arg == "exemplar" for kw in node.keywords)
-        ):
-            violations.append(
-                f"{rel}:{node.lineno}: exemplar= on .{fn.attr}(); exemplars "
-                "attach to histogram observe() only (non-histogram series "
-                "cannot carry them)"
-            )
-            continue
-        # Mint calls: .counter / .gauge / .histogram on anything (in this
-        # tree only Registry objects expose these method names).
-        if isinstance(fn, ast.Attribute) and fn.attr in MINT_METHODS:
-            arg = _name_arg(node)
-            if arg is None:
-                continue
-            head = _literal_head(arg)
-            if head is None:
-                violations.append(
-                    f"{rel}:{node.lineno}: .{fn.attr}() with a non-literal "
-                    "metric name; names must be statically auditable"
-                )
-            elif not head.startswith(METRIC_PREFIX):
-                violations.append(
-                    f"{rel}:{node.lineno}: metric name {head!r} is not "
-                    f"{METRIC_PREFIX}-prefixed"
-                )
-            elif not is_metrics_module and (
-                any(head.startswith(p) for p in CENTRAL_PREFIXES)
-                or head in CENTRAL_NAMES
-            ):
-                violations.append(
-                    f"{rel}:{node.lineno}: {head!r} minted outside "
-                    "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
-                    "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_* series (and "
-                    "kdlt_engine_warm_source) are minted only by the central "
-                    "helpers (bounded label sets by construction)"
-                )
-    return violations
+    mod = ModuleInfo(rel.replace(os.sep, "/"), src)
+    findings = MetricsNamingPass().check_module(mod, LintContext(REPO))
+    return [f"{f.rel}:{f.line}: {f.message}" for f in findings]
 
 
 def iter_production_files() -> list[str]:
-    files: list[str] = [os.path.join(REPO, f) for f in EXTRA_FILES]
-    for dirpath, dirnames, filenames in os.walk(os.path.join(REPO, PACKAGE)):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_PARTS]
-        files.extend(
-            os.path.join(dirpath, f) for f in sorted(filenames)
-            if f.endswith(".py")
-        )
-    return files
+    return _iter_files(REPO)
 
 
 def main() -> int:

@@ -1,0 +1,37 @@
+"""kdlt-lint: the repo's unified static-analysis suite.
+
+One AST parse per production file; passes are registered visitors sharing
+that parse plus the module's import/alias resolution.  Rules:
+
+- ``guarded-by``          attributes annotated ``# guarded-by: _lock`` are
+                          only touched inside ``with self._lock``
+- ``lock-order``          the cross-class lock-acquisition graph is acyclic
+- ``blocking-under-lock`` no time.sleep / socket reads / .result() without
+                          timeout while holding a lock
+- ``hot-path-sync``       no host syncs (np.asarray / block_until_ready /
+                          .item() / float()) in functions reachable from the
+                          dispatcher/engine forward path
+- ``lock-around-jit``     no lock held around a jitted call on the hot path
+- ``donation-safety``     no reads of an array after it was passed to a
+                          donate_argnums jit in the same function
+- ``closed-vocab``        span names, fault points, flight-recorder event
+                          kinds and incident trigger names are members of
+                          their declared vocabularies
+- ``metrics-naming``      the tools/check_metrics.py rules, as a pass
+- ``env-knobs``           the tools/check_env.py rules, as a pass
+- ``unused-suppression``  every ``# kdlt-lint: disable=`` comment must
+                          actually suppress something
+
+Suppression grammar (same line, or a standalone comment line covering the
+next line)::
+
+    x = self._hits  # kdlt-lint: disable=guarded-by -- monitoring read, torn reads OK
+"""
+
+from kdlt_lint.core import (  # noqa: F401
+    Finding,
+    LintContext,
+    ModuleInfo,
+    iter_production_files,
+    run_lint,
+)

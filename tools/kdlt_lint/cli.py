@@ -1,0 +1,70 @@
+"""kdlt-lint command line: human and --json output over the full suite."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kdlt_lint.core import REPO, default_passes, run_lint
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kdlt-lint",
+        description="unified static-analysis suite for the serving tree",
+    )
+    ap.add_argument("--json", action="store_true", help="stable JSON output")
+    ap.add_argument(
+        "--rule", action="append", default=None, metavar="RULE",
+        help="only report these rule ids (repeatable)",
+    )
+    ap.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also list findings silenced by kdlt-lint: disable comments",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--repo", default=REPO, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    passes = default_passes()
+    if args.list_rules:
+        for p in passes:
+            for r in p.rules:
+                print(f"{r}  ({p.name} pass)")
+        print("unused-suppression  (framework)")
+        return 0
+
+    findings = run_lint(passes, repo=args.repo)
+    if args.rule:
+        wanted = set(args.rule)
+        findings = [f for f in findings if f.rule in wanted]
+    active = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.json:
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.as_json() for f in findings],
+            "summary": {
+                "active": len(active),
+                "suppressed": len(suppressed),
+            },
+        }, indent=2, sort_keys=True))
+        return 1 if active else 0
+
+    for f in active:
+        print(f.format())
+    if args.show_suppressed:
+        for f in suppressed:
+            print(f"{f.format()}  [suppressed]")
+    if active:
+        print(f"kdlt-lint: {len(active)} finding(s) "
+              f"({len(suppressed)} suppressed)")
+        return 1
+    print(f"kdlt-lint: clean ({len(suppressed)} suppressed finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

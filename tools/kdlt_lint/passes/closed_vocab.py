@@ -1,0 +1,179 @@
+"""Closed-vocabulary pass.
+
+Span names, fault-injection points, flight-recorder event kinds, and
+incident trigger names are operational contracts: dashboards,
+``kdlt-doctor`` and the trace tooling key on the exact strings.  Each
+vocabulary has exactly one declaring registry; any string literal used at a
+recording/firing call site must be a member:
+
+- span names          -> ``utils/trace.py``          ``SPAN_NAMES``
+- fault points        -> ``serving/faults.py``       ``FAULT_POINTS``
+- event kinds         -> ``utils/flightrecorder.py`` ``EVENT_KINDS``
+- incident triggers   -> ``utils/flightrecorder.py`` ``TRIGGER_RULES``
+
+The registries are extracted from the AST (module-level assignments of
+string-literal collections, with module-level ``NAME = "literal"``
+constants resolved), so the pass needs no imports of the production tree.
+
+Call-site dispatch is by receiver shape: ``*.span("x")`` and
+``*tracer.record(rid, "x", ...)`` / ``*trace.record("x", ...)`` are span
+sites; ``*recorder.record("x", ...)`` (and ``self.record`` /
+``self._emit`` inside the recorder/pool modules) are event-kind sites;
+``*.fire("x")`` / ``*.corrupt("x", ...)`` are fault points;
+``*.trigger_threshold("x", ...)`` is a trigger name.  Non-literal
+arguments are skipped -- they are validated at runtime by the registries
+themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kdlt_lint.core import (
+    PACKAGE,
+    Finding,
+    LintContext,
+    LintPass,
+    ModuleInfo,
+    dotted,
+)
+
+TRACE_MODULE = f"{PACKAGE}/utils/trace.py"
+FAULTS_MODULE = f"{PACKAGE}/serving/faults.py"
+RECORDER_MODULE = f"{PACKAGE}/utils/flightrecorder.py"
+
+VOCABS = (
+    ("span", TRACE_MODULE, "SPAN_NAMES"),
+    ("fault-point", FAULTS_MODULE, "FAULT_POINTS"),
+    ("event-kind", RECORDER_MODULE, "EVENT_KINDS"),
+    ("trigger", RECORDER_MODULE, "TRIGGER_RULES"),
+)
+
+# Modules whose bare self.record / self._emit / self.fire calls are
+# in-registry emitters rather than consumer call sites.
+SELF_EMITTER_MODULES = {
+    RECORDER_MODULE: "event-kind",
+    f"{PACKAGE}/serving/upstream.py": "event-kind",
+}
+
+
+def extract_vocab(mod: ModuleInfo, name: str) -> frozenset[str] | None:
+    """Evaluate a module-level registry assignment into a set of strings."""
+    consts: dict[str, str] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts[tgt.id] = node.value.value
+
+    def ev(node: ast.expr) -> list[str] | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, ast.Name) and node.id in consts:
+            return [consts[node.id]]
+        if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+            out: list[str] = []
+            for e in node.elts:
+                got = ev(e)
+                if got is None:
+                    return None
+                out.extend(got)
+            return out
+        if isinstance(node, ast.Dict):
+            out = []
+            for k in node.keys:
+                got = ev(k) if k is not None else None
+                if got is None:
+                    return None
+                out.extend(got)
+            return out
+        if isinstance(node, ast.Call):
+            parts = dotted(node.func)
+            if parts and parts[-1] in ("frozenset", "set", "tuple", "dict") and node.args:
+                return ev(node.args[0])
+        return None
+
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    got = ev(node.value)
+                    if got is not None:
+                        return frozenset(got)
+    return None
+
+
+class ClosedVocabPass(LintPass):
+    name = "closed-vocab"
+    rules = ("closed-vocab",)
+
+    def _vocabs(self, ctx: LintContext) -> dict[str, frozenset[str] | None]:
+        cached = ctx.scratch.get("vocab.sets")
+        if cached is None:
+            cached = {}
+            for vocab, rel, reg in VOCABS:
+                mod = ctx.module(rel)
+                cached[vocab] = extract_vocab(mod, reg) if mod else None
+            ctx.scratch["vocab.sets"] = cached
+        return cached
+
+    def check_module(self, mod: ModuleInfo, ctx: LintContext) -> list[Finding]:
+        vocabs = self._vocabs(ctx)
+        findings: list[Finding] = []
+
+        def member(vocab: str, value: str, line: int, what: str) -> None:
+            known = vocabs.get(vocab)
+            if known is None:
+                findings.append(Finding(
+                    "closed-vocab", mod.rel, line,
+                    f"{what} {value!r} used but the {vocab} registry is "
+                    "missing from its declaring module",
+                ))
+            elif value not in known:
+                findings.append(Finding(
+                    "closed-vocab", mod.rel, line,
+                    f"{what} {value!r} is not in the declared {vocab} "
+                    f"vocabulary; add it to the registry or fix the typo",
+                ))
+
+        def lit(node: ast.expr | None) -> str | None:
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                return node.value
+            return None
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+                continue
+            meth = node.func.attr
+            recv = dotted(node.func.value)  # e.g. ["self", "recorder"]
+            recv_tail = recv[-1] if recv else None
+            arg0 = lit(node.args[0]) if node.args else None
+            if meth == "span":
+                if arg0 is not None:
+                    member("span", arg0, node.lineno, "span name")
+            elif meth in ("fire", "corrupt"):
+                if arg0 is not None:
+                    member("fault-point", arg0, node.lineno, "fault point")
+            elif meth == "trigger_threshold":
+                if arg0 is not None:
+                    member("trigger", arg0, node.lineno, "incident trigger")
+            elif meth == "record" and recv_tail is not None:
+                if recv_tail == "recorder" or (
+                    recv == ["self"] and SELF_EMITTER_MODULES.get(mod.rel) == "event-kind"
+                ):
+                    if arg0 is not None:
+                        member("event-kind", arg0, node.lineno, "event kind")
+                elif recv_tail == "tracer":
+                    name = lit(node.args[1]) if len(node.args) > 1 else None
+                    if name is not None:
+                        member("span", name, node.lineno, "span name")
+                elif recv_tail in ("trace", "tr", "rt", "pt") or (
+                    recv is not None and recv[-1] == "trace"
+                ):
+                    if arg0 is not None:
+                        member("span", arg0, node.lineno, "span name")
+            elif meth == "_emit" and recv == ["self"]:
+                if SELF_EMITTER_MODULES.get(mod.rel) == "event-kind" and arg0 is not None:
+                    member("event-kind", arg0, node.lineno, "event kind")
+        return findings

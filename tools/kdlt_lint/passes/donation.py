@@ -1,0 +1,200 @@
+"""Donation-safety pass.
+
+Buffer donation (``jax.jit(..., donate_argnums=...)``) invalidates the
+donated device buffer the moment the program runs; reading the same array
+afterwards is a use-after-free that surfaces as intermittent corruption or
+a segfault timed by the async dispatch (the PR 9 ``training/checkpoint.py``
+bug: the train loop donated ``state`` into the next step while orbax's
+background serializer was still reading its device buffers).
+
+Rule ``donation-safety``: inside one function, after an array expression is
+passed at a donated position of a donating callable, any later read of the
+same name (or ``self.attr``) is flagged until it is reassigned.
+
+Donating callables are recognized as:
+
+- names or ``self`` attributes assigned ``jax.jit(fn, donate_argnums=...)``
+  (or ``pjit``) anywhere in the module/class;
+- names assigned from a call to an in-module factory whose return statement
+  is such a jit (``build_train_step``-style);
+- ``_donate_jit(fn, ...)`` -- the engine's helper -- which donates argnum 1
+  by contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kdlt_lint.core import Finding, LintContext, LintPass, ModuleInfo, dotted
+
+JIT_FUNCS = {"jax.jit", "jax.pjit", "pjit.pjit"}
+
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """donate_argnums positions of a jit call, or None when not donating."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+            return ()  # dynamic: donating, positions unknown
+    return None
+
+
+def _expr_key(node: ast.expr) -> str | None:
+    """A stable key for a donatable argument: a bare name or self.attr."""
+    if isinstance(node, ast.Name):
+        return node.id
+    parts = dotted(node)
+    if parts and parts[0] == "self" and len(parts) == 2:
+        return f"self.{parts[1]}"
+    return None
+
+
+class DonationSafetyPass(LintPass):
+    name = "donation"
+    rules = ("donation-safety",)
+
+    def check_module(self, mod: ModuleInfo, ctx: LintContext) -> list[Finding]:
+        donating = self._collect_donating(mod)
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(mod, node, donating))
+        return findings
+
+    # --- donating-callable discovery --------------------------------------
+
+    def _is_donating_jit(self, mod: ModuleInfo, value: ast.expr):
+        """(positions) when ``value`` is a donating jit construction."""
+        if not isinstance(value, ast.Call):
+            return None
+        resolved = mod.resolve(value.func) or ""
+        if resolved in JIT_FUNCS or resolved.endswith(".pjit"):
+            return _donated_positions(value)
+        if resolved.rpartition(".")[2] == "_donate_jit":
+            return (1,)  # the engine helper's contract: argnum 1 is donated
+        return None
+
+    def _collect_donating(self, mod: ModuleInfo) -> dict[str, tuple[int, ...]]:
+        """Names/attrs known to be donating callables, module-wide:
+        ``name`` / ``self.name`` -> donated positions."""
+        donating: dict[str, tuple[int, ...]] = {}
+        factories: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Return) and sub.value is not None:
+                        pos = self._is_donating_jit(mod, sub.value)
+                        if pos:
+                            factories[node.name] = pos
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            pos = self._is_donating_jit(mod, node.value)
+            if pos is None and isinstance(node.value, ast.Call):
+                resolved = mod.resolve(node.value.func) or ""
+                tail = resolved.rpartition(".")[2]
+                if tail in factories:
+                    pos = factories[tail]
+
+            if not pos:
+                continue
+            for tgt in node.targets:
+                key = _expr_key(tgt)
+                if key is not None:
+                    donating[key] = pos
+                    if key.startswith("self."):
+                        donating[key[len("self."):]] = pos
+        return donating
+
+    # --- per-function use-after-donate check -------------------------------
+
+    def _check_function(self, mod: ModuleInfo, fn,
+                        donating: dict[str, tuple[int, ...]]) -> list[Finding]:
+        local_donating = dict(donating)
+        events: list[tuple[int, int, str, object]] = []  # (line, col, kind, payload)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                pos = self._is_donating_jit(mod, node.value)
+                if pos:
+                    for tgt in node.targets:
+                        key = _expr_key(tgt)
+                        if key is not None:
+                            local_donating[key] = pos
+            if isinstance(node, ast.Call):
+                callee = node.func
+                ckey = _expr_key(callee) or (
+                    f"self.{callee.attr}"
+                    if isinstance(callee, ast.Attribute)
+                    and isinstance(callee.value, ast.Name)
+                    and callee.value.id == "self"
+                    else None
+                )
+                pos = local_donating.get(ckey or "")
+                if pos:
+                    for p in pos:
+                        if p < len(node.args):
+                            akey = _expr_key(node.args[p])
+                            if akey is not None:
+                                events.append((
+                                    node.lineno, node.col_offset, "donate",
+                                    (akey, ckey, node.end_lineno or node.lineno),
+                                ))
+
+        if not any(e[2] == "donate" for e in events):
+            return []
+
+        # second walk: loads and kills, ordered by position
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                events.append((node.lineno, node.col_offset, "load", node.id))
+            elif isinstance(node, ast.Attribute):
+                key = _expr_key(node)
+                if key is not None and isinstance(node.ctx, ast.Load):
+                    events.append((node.lineno, node.col_offset, "load", key))
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for tgt in targets:
+                    key = _expr_key(tgt)
+                    if key is not None:
+                        # a kill takes effect AFTER the statement's RHS ran,
+                        # so order it at the statement's end: `state =
+                        # step(state, ...)` donates then rebinds
+                        events.append((
+                            node.end_lineno or node.lineno,
+                            (node.end_col_offset or 0) + 10_000, "kill", key,
+                        ))
+
+        events.sort(key=lambda e: (e[0], e[1], e[2] != "donate"))
+        findings: list[Finding] = []
+        # key -> (donate line, end line of the donating call, callee)
+        tainted: dict[str, tuple[int, int, str]] = {}
+        for line, _col, kind, payload in events:
+            if kind == "donate":
+                akey, ckey, end_line = payload
+                tainted[akey] = (line, end_line, ckey or "a donating jit")
+            elif kind == "kill":
+                tainted.pop(payload, None)
+            elif kind == "load" and payload in tainted:
+                dline, dend, ckey = tainted[payload]
+                if line > dend:
+                    findings.append(Finding(
+                        "donation-safety", mod.rel, line,
+                        f"{payload} was donated to {ckey} at line {dline} "
+                        "and is read afterwards; the donated device buffer "
+                        "may already be recycled (use-after-donate -- the "
+                        "PR 9 checkpoint bug class). Copy to host before "
+                        "donating, or reassign the result",
+                    ))
+                    tainted.pop(payload, None)  # one report per donation
+        return findings

@@ -1,0 +1,173 @@
+"""Env-knob pass: tools/check_env.py's rules on the framework.
+
+Every whole-string ``KDLT_*`` literal in production code must be
+documented in GUIDE.md; deploy-manifest keys must be read by code; the
+compose replica pair must be identical; and each tier's compose/k8s
+mirrors must agree modulo DEPLOY_AGREEMENT's declared drift allowances.
+
+DEPLOY_AGREEMENT is the pass's declarative config: the tier mirror map and
+the two drift lists are data here, not logic in the checker -- adding an
+allowance is a one-line config change (tools/check_env.py re-exports them
+for its tests).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from kdlt_lint.core import Finding, LintContext, LintPass, ModuleInfo
+
+GUIDE = "GUIDE.md"
+ENV_RE = re.compile(r"KDLT_[A-Z0-9_]+\Z")
+
+COMPOSE = os.path.join("deploy", "docker-compose.yaml")
+K8S_GATEWAY = os.path.join("deploy", "k8s", "gateway-deployment.yaml")
+K8S_MODEL = os.path.join("deploy", "k8s", "model-server-deployment.yaml")
+
+# Declarative deploy-agreement config: which compose services mirror which
+# k8s manifest, which replica pairs must match exactly, and which knobs may
+# legitimately drift between environments.
+DEPLOY_AGREEMENT = {
+    # (tier name, compose service names, k8s manifest)
+    "tiers": (
+        ("gateway", ("gateway",), K8S_GATEWAY),
+        ("model-server", ("model-server", "model-server-b"), K8S_MODEL),
+    ),
+    # compose services that fail over behind one gateway: identical maps
+    "replica_pairs": (("model-server", "model-server-b"),),
+    # host-ish knobs: the VALUE legitimately differs between compose
+    # (service names on the compose network) and k8s (cluster DNS)
+    "allow_value_drift": frozenset({"KDLT_SERVING_HOST"}),
+    # path-ish knobs tied to a volume only one environment mounts;
+    # presence on one side only is fine
+    "allow_presence_drift": frozenset({
+        "KDLT_COMPILE_CACHE_DIR", "KDLT_PROFILE_DIR",
+    }),
+}
+
+
+def env_literals(src: str, rel: str) -> dict[str, int]:
+    """Whole-string KDLT_* literals in a module -> first line seen."""
+    found: dict[str, int] = {}
+    for node in ast.walk(ast.parse(src, filename=rel)):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and ENV_RE.match(node.value)
+        ):
+            found.setdefault(node.value, node.lineno)
+    return found
+
+
+def compose_env(doc: dict, service: str) -> dict[str, str]:
+    svc = (doc.get("services") or {}).get(service) or {}
+    env = svc.get("environment") or {}
+    if isinstance(env, list):  # compose also allows ["K=V", ...]
+        env = dict(item.split("=", 1) for item in env)
+    return {k: str(v) for k, v in env.items() if k.startswith("KDLT_")}
+
+
+def k8s_env(doc: dict) -> dict[str, str]:
+    tmpl = doc.get("spec", {}).get("template", {}).get("spec", {})
+    out: dict[str, str] = {}
+    for container in tmpl.get("containers") or []:
+        for item in container.get("env") or []:
+            name = item.get("name", "")
+            if name.startswith("KDLT_"):
+                out[name] = str(item.get("value", ""))
+    return out
+
+
+class EnvKnobsPass(LintPass):
+    name = "env"
+    rules = ("env-knobs",)
+
+    def check_module(self, mod: ModuleInfo, ctx: LintContext) -> list[Finding]:
+        code_envs: dict = ctx.scratch.setdefault("env.code_envs", {})
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and ENV_RE.match(node.value)
+            ):
+                code_envs.setdefault(node.value, (mod.rel, node.lineno))
+        return []
+
+    def finalize(self, ctx: LintContext) -> list[Finding]:
+        findings: list[Finding] = []
+        code_envs: dict = ctx.scratch.get("env.code_envs", {})
+
+        guide_path = os.path.join(ctx.repo, GUIDE)
+        with open(guide_path) as f:
+            guide_text = f.read()
+        for name in sorted(code_envs):
+            rel, line = code_envs[name]
+            if name not in guide_text:
+                findings.append(Finding(
+                    "env-knobs", rel, line,
+                    f"{name} is read by production code but "
+                    f"never mentioned in {GUIDE}; document the knob",
+                ))
+
+        import yaml
+
+        with open(os.path.join(ctx.repo, COMPOSE)) as f:
+            compose_doc = yaml.safe_load(f)
+        k8s_docs = {}
+        for manifest in (K8S_GATEWAY, K8S_MODEL):
+            with open(os.path.join(ctx.repo, manifest)) as f:
+                k8s_docs[manifest] = yaml.safe_load(f)
+
+        deploy_maps: list[tuple[str, dict[str, str]]] = []
+        for tier, services, manifest in DEPLOY_AGREEMENT["tiers"]:
+            for svc in services:
+                deploy_maps.append(
+                    (f"{COMPOSE}:{svc}", compose_env(compose_doc, svc))
+                )
+            deploy_maps.append((manifest, k8s_env(k8s_docs[manifest])))
+        for where, env in deploy_maps:
+            for name in sorted(env):
+                if name not in code_envs:
+                    findings.append(Finding(
+                        "env-knobs", where.split(":")[0], 0,
+                        f"{where}: {name} is set but no production code reads "
+                        "it (typo'd knob names are silently ignored at runtime)",
+                    ))
+
+        for pair_names in DEPLOY_AGREEMENT["replica_pairs"]:
+            pair = [compose_env(compose_doc, s) for s in pair_names]
+            if pair[0] != pair[1]:
+                diff = sorted(set(pair[0].items()) ^ set(pair[1].items()))
+                findings.append(Finding(
+                    "env-knobs", COMPOSE, 0,
+                    f"{COMPOSE}: {' and '.join(pair_names)} disagree on "
+                    f"{sorted({k for k, _ in diff})}; the gateway fails over "
+                    "between them, so their KDLT_* maps must be identical",
+                ))
+
+        allow_presence = DEPLOY_AGREEMENT["allow_presence_drift"]
+        allow_value = DEPLOY_AGREEMENT["allow_value_drift"]
+        for tier, services, manifest in DEPLOY_AGREEMENT["tiers"]:
+            c_env = compose_env(compose_doc, services[0])
+            k_env = k8s_env(k8s_docs[manifest])
+            for name in sorted(set(c_env) | set(k_env)):
+                if name in allow_presence:
+                    continue
+                if name not in c_env or name not in k_env:
+                    missing = COMPOSE if name not in c_env else manifest
+                    findings.append(Finding(
+                        "env-knobs", missing, 0,
+                        f"{tier}: {name} is wired in one environment but "
+                        f"missing from {missing}; compose and k8s mirrors of "
+                        "a tier must set the same knobs",
+                    ))
+                elif name not in allow_value and c_env[name] != k_env[name]:
+                    findings.append(Finding(
+                        "env-knobs", COMPOSE, 0,
+                        f"{tier}: {name} disagrees between {COMPOSE} "
+                        f"({c_env[name]!r}) and {manifest} ({k_env[name]!r})",
+                    ))
+        ctx.scratch["env.knob_count"] = len(code_envs)
+        return findings

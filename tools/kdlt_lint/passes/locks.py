@@ -1,0 +1,399 @@
+"""Lock-discipline pass.
+
+Three rules over the same per-class model:
+
+- ``guarded-by``: an attribute whose declaration carries a
+  ``# guarded-by: _lock`` annotation may only be read or written inside
+  ``with self._lock:`` (or a ``threading.Condition`` constructed over that
+  lock).  ``__init__`` is exempt (construction happens before the object is
+  published) and so are methods whose name ends in ``_locked`` (the tree's
+  convention for "caller already holds the lock").
+- ``lock-order``: the cross-class lock-acquisition graph (edges from every
+  lock held to every lock acquired under it, following same-class method
+  calls and calls through attributes whose class is statically known) must
+  be acyclic -- a cycle is a static deadlock.
+- ``blocking-under-lock``: no ``time.sleep``, network calls
+  (``requests.get/post/...``, ``urllib.request.urlopen``, ``.recv`` /
+  ``.accept``), or ``.result()`` without a timeout while a lock is held.
+  ``Condition.wait`` is exempt: it releases the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from kdlt_lint.core import (
+    PACKAGE,
+    Finding,
+    LintContext,
+    LintPass,
+    ModuleInfo,
+    dotted,
+)
+
+LOCK_FACTORIES = {"threading.Lock", "threading.RLock", "threading.Condition"}
+SOCKET_READ_ATTRS = {"recv", "recv_into", "recvfrom", "accept", "getresponse"}
+# Fully-resolved callables that hit the network (constructors like
+# requests.Session() are cheap and deliberately NOT listed).
+BLOCKING_CALLS = {
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.patch", "requests.request",
+    "urllib.request.urlopen",
+}
+# Attributes whose calls release or merely bound the lock: Condition.wait
+# releases it; a bounded .result(timeout) / .join(timeout) is the caller's
+# explicit choice and carries the timeout we check for.
+EXEMPT_ATTRS = {"wait", "wait_for", "acquire", "release", "notify", "notify_all"}
+
+
+def _rel_to_dotted(rel: str) -> str | None:
+    rel = rel.replace("\\", "/")
+    if not rel.startswith(PACKAGE + "/") or not rel.endswith(".py"):
+        return None
+    mod = rel[: -len(".py")].replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass
+class ClassModel:
+    rel: str
+    name: str
+    lock_attrs: set[str] = field(default_factory=set)
+    # Condition attr -> the lock attr it wraps (Condition(self._lock))
+    cond_proxy: dict[str, str] = field(default_factory=dict)
+    guarded: dict[str, str] = field(default_factory=dict)  # attr -> lock attr
+    guard_lines: dict[str, int] = field(default_factory=dict)
+    # attr -> (rel, ClassName) of the instance assigned to it, when the
+    # constructor call is statically resolvable to an in-tree class
+    attr_types: dict[str, tuple[str, str]] = field(default_factory=dict)
+    # method -> [(lock, locks held lexically at the acquire, line)]
+    acquires: dict[str, list[tuple[str, frozenset[str], int]]] = field(default_factory=dict)
+    # method -> [(kind, name, locks held at the call, line)]
+    #   kind: "self" (self.m()), "attr" ((attrname, m)), "ext" (resolved dotted)
+    calls: dict[str, list[tuple[str, object, frozenset[str], int]]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.rel, self.name)
+
+    def holds(self, held: frozenset[str], lock: str) -> bool:
+        if lock in held:
+            return True
+        return any(self.cond_proxy.get(h) == lock for h in held)
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    """Walk one method tracking the lexically-held lock set."""
+
+    def __init__(self, pass_, mod: ModuleInfo, cm: ClassModel, fn: ast.FunctionDef):
+        self.p = pass_
+        self.mod = mod
+        self.cm = cm
+        self.fn = fn
+        self.held: tuple[str, ...] = ()
+        self.findings: list[Finding] = []
+        self.check_guards = not (
+            fn.name == "__init__" or fn.name.endswith("_locked")
+        )
+
+    def _frozen(self) -> frozenset[str]:
+        return frozenset(self.held)
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node)
+
+    def _visit_with(self, node) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            attr = _self_attr(expr)
+            if attr is not None and attr in self.cm.lock_attrs:
+                self.cm.acquires.setdefault(self.fn.name, []).append(
+                    (attr, self._frozen(), expr.lineno)
+                )
+                acquired.append(attr)
+            # still visit the context expression itself (it may read
+            # guarded attributes, e.g. `with self._flights[key]:`)
+            self.visit(expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held = self.held + tuple(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            self.held = self.held[: len(self.held) - len(acquired)]
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if (
+            self.check_guards
+            and attr is not None
+            and attr in self.cm.guarded
+        ):
+            lock = self.cm.guarded[attr]
+            if not self.cm.holds(self._frozen(), lock):
+                self.findings.append(Finding(
+                    "guarded-by", self.mod.rel, node.lineno,
+                    f"self.{attr} is declared guarded-by {lock} "
+                    f"({self.mod.rel}:{self.cm.guard_lines.get(attr, 0)}) but "
+                    f"is touched in {self.cm.name}.{self.fn.name} without "
+                    f"holding self.{lock}",
+                ))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        held = self._frozen()
+        fn = node.func
+        # record the call for the lock-order graph
+        attr = _self_attr(fn)
+        if attr is not None:
+            self.cm.calls.setdefault(self.fn.name, []).append(
+                ("self", attr, held, node.lineno)
+            )
+        elif isinstance(fn, ast.Attribute):
+            recv_attr = _self_attr(fn.value)
+            if recv_attr is not None:
+                self.cm.calls.setdefault(self.fn.name, []).append(
+                    ("attr", (recv_attr, fn.attr), held, node.lineno)
+                )
+        if held:
+            self._check_blocking(node, held)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call, held: frozenset[str]) -> None:
+        fn = node.func
+        resolved = self.mod.resolve(fn) or ""
+        where = f"while holding self.{'/self.'.join(sorted(held))}"
+        if resolved == "time.sleep":
+            self.findings.append(Finding(
+                "blocking-under-lock", self.mod.rel, node.lineno,
+                f"time.sleep() {where}; sleeping under a lock stalls every "
+                "waiter for the full duration",
+            ))
+            return
+        if resolved in BLOCKING_CALLS:
+            self.findings.append(Finding(
+                "blocking-under-lock", self.mod.rel, node.lineno,
+                f"network call {resolved}() {where}; socket reads under a "
+                "lock stall every waiter on the peer's latency",
+            ))
+            return
+        if isinstance(fn, ast.Attribute) and fn.attr in EXEMPT_ATTRS:
+            return
+        if isinstance(fn, ast.Attribute) and fn.attr in SOCKET_READ_ATTRS:
+            self.findings.append(Finding(
+                "blocking-under-lock", self.mod.rel, node.lineno,
+                f".{fn.attr}() {where}; socket reads under a lock stall "
+                "every waiter on the peer's latency",
+            ))
+            return
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "result"
+            and not node.args
+            and not any(kw.arg == "timeout" for kw in node.keywords)
+        ):
+            self.findings.append(Finding(
+                "blocking-under-lock", self.mod.rel, node.lineno,
+                f".result() without a timeout {where}; an unbounded future "
+                "wait under a lock can deadlock against the completer",
+            ))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs inherit the lexical held set (closures run later, but
+        # flagging a guarded access inside one is conservative-correct for
+        # this tree, where nested defs run inline or on unlocked threads)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class LockDisciplinePass(LintPass):
+    name = "lock-discipline"
+    rules = ("guarded-by", "lock-order", "blocking-under-lock")
+
+    def check_module(self, mod: ModuleInfo, ctx: LintContext) -> list[Finding]:
+        findings: list[Finding] = []
+        models: list[ClassModel] = ctx.scratch.setdefault("lock.models", [])
+        for cls in [n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)]:
+            cm = self._build_class_model(mod, cls)
+            models.append(cm)
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    v = _MethodVisitor(self, mod, cm, fn)
+                    for stmt in fn.body:
+                        v.visit(stmt)
+                    findings.extend(v.findings)
+        return findings
+
+    def _build_class_model(self, mod: ModuleInfo, cls: ast.ClassDef) -> ClassModel:
+        cm = ClassModel(mod.rel, cls.name)
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                if isinstance(value, ast.Call):
+                    resolved = mod.resolve(value.func) or ""
+                    if resolved in LOCK_FACTORIES:
+                        cm.lock_attrs.add(attr)
+                        if resolved.endswith("Condition") and value.args:
+                            wrapped = _self_attr(value.args[0])
+                            if wrapped is not None:
+                                cm.cond_proxy[attr] = wrapped
+                    else:
+                        cls_key = self._class_of(mod, value.func)
+                        if cls_key is not None:
+                            cm.attr_types[attr] = cls_key
+                lock = mod.guarded_by_on_line(node.lineno)
+                if lock is not None:
+                    cm.guarded[attr] = lock
+                    cm.guard_lines[attr] = node.lineno
+        return cm
+
+    def _class_of(self, mod: ModuleInfo, func: ast.expr) -> tuple[str, str] | None:
+        """(rel, ClassName) when ``func`` names a class defined in the
+        scanned tree (same module, or imported from a package module)."""
+        parts = dotted(func)
+        if not parts:
+            return None
+        resolved = mod.resolve(func) or ""
+        if resolved.startswith(PACKAGE + "."):
+            dotted_mod, _, name = resolved.rpartition(".")
+            rel = dotted_mod.replace(".", "/") + ".py"
+            return (rel, name)
+        if len(parts) == 1:
+            return (mod.rel, parts[0])  # same-module class (verified later)
+        return None
+
+    # --- lock-order graph --------------------------------------------------
+
+    def finalize(self, ctx: LintContext) -> list[Finding]:
+        models: list[ClassModel] = ctx.scratch.get("lock.models", [])
+        by_key = {cm.key: cm for cm in models}
+        edges: dict[str, set[str]] = {}
+        sites: dict[tuple[str, str], tuple[str, int]] = {}
+
+        def node_id(cm: ClassModel, lock: str) -> str:
+            return f"{cm.name}.{lock}"
+
+        def add_edge(a: str, b: str, rel: str, line: int) -> None:
+            if a == b:
+                return
+            edges.setdefault(a, set()).add(b)
+            sites.setdefault((a, b), (rel, line))
+
+        def walk(cm: ClassModel, method: str, held_nodes: frozenset[str],
+                 depth: int, seen: set) -> None:
+            if depth > 4 or (cm.key, method, held_nodes) in seen:
+                return
+            seen.add((cm.key, method, held_nodes))
+            for lock, local_held, line in cm.acquires.get(method, ()):  # direct
+                target = node_id(cm, lock)
+                context = held_nodes | {node_id(cm, l) for l in local_held}
+                for h in context:
+                    add_edge(h, target, cm.rel, line)
+            for kind, name, local_held, _line in cm.calls.get(method, ()):
+                context = held_nodes | {node_id(cm, l) for l in local_held}
+                if kind == "self":
+                    if name in cm.acquires or name in cm.calls:
+                        walk(cm, name, frozenset(context), depth + 1, seen)
+                elif kind == "attr":
+                    attr, meth = name
+                    target_key = cm.attr_types.get(attr)
+                    target = by_key.get(target_key) if target_key else None
+                    if target is not None and (
+                        meth in target.acquires or meth in target.calls
+                    ):
+                        walk(target, meth, frozenset(context), depth + 1, seen)
+
+        seen: set = set()
+        for cm in models:
+            for method in set(cm.acquires) | set(cm.calls):
+                walk(cm, method, frozenset(), 0, seen)
+
+        return self._find_cycles(edges, sites)
+
+    def _find_cycles(self, edges, sites) -> list[Finding]:
+        findings: list[Finding] = []
+        # iterative Tarjan SCC; any SCC of size > 1 is a potential deadlock
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        onstack: set[str] = set()
+        stack: list[str] = []
+        counter = [0]
+        sccs: list[list[str]] = []
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(sorted(edges.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            onstack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        onstack.add(w)
+                        work.append((w, iter(sorted(edges.get(w, ())))))
+                        advanced = True
+                        break
+                    elif w in onstack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        onstack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+
+        for v in sorted(edges):
+            if v not in index:
+                strongconnect(v)
+        for scc in sccs:
+            members = sorted(scc)
+            pairs = [
+                (a, b) for a in members for b in edges.get(a, ())
+                if b in scc
+            ]
+            rel, line = sites.get(pairs[0], ("<tree>", 0)) if pairs else ("<tree>", 0)
+            findings.append(Finding(
+                "lock-order", rel, line,
+                "lock-acquisition-order cycle between "
+                f"{' and '.join(members)}: two threads taking these locks "
+                "in opposite orders deadlock; impose one global order",
+            ))
+        return findings

@@ -1,0 +1,164 @@
+"""Metrics-naming pass: tools/check_metrics.py's rules on the framework.
+
+Same rules, same message text (tools/check_metrics.py is now a shim over
+this pass and its tests assert on these strings): every series is
+kdlt_-prefixed and minted through the central helpers in utils/metrics.py;
+bounded labels and the central prefixes stay confined to that module;
+exemplars attach to histograms only.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kdlt_lint.core import (
+    PACKAGE,
+    Finding,
+    LintContext,
+    LintPass,
+    ModuleInfo,
+    literal_head,
+)
+
+METRIC_PREFIX = "kdlt_"
+MINT_METHODS = {"counter", "gauge", "histogram"}
+METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+CENTRAL_LABELS = {
+    "model", "window", "class", "reason", "scheme", "source",
+    "stage", "direction", "trigger",
+}
+CENTRAL_PREFIXES = (
+    "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
+    "kdlt_incident_",
+)
+CENTRAL_NAMES = ("kdlt_engine_warm_source",)
+METRICS_MODULE = f"{PACKAGE}.utils.metrics"
+
+
+def _name_arg(call: ast.Call) -> ast.expr | None:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+class MetricsNamingPass(LintPass):
+    name = "metrics"
+    rules = ("metrics-naming",)
+
+    def check_module(self, mod: ModuleInfo, ctx: LintContext) -> list[Finding]:
+        violations: list[Finding] = []
+        tree = mod.tree
+        rel = mod.rel
+
+        def flag(line: int, message: str) -> None:
+            violations.append(Finding("metrics-naming", rel, line, message))
+
+        # Aliases under which this module can reach the metric classes.
+        metrics_module_aliases: set[str] = set()
+        metric_class_aliases: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == METRICS_MODULE:
+                        metrics_module_aliases.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == METRICS_MODULE.rsplit(".", 1)[0]:
+                    for a in node.names:
+                        if a.name == "metrics":
+                            metrics_module_aliases.add(a.asname or a.name)
+                elif node.module == METRICS_MODULE:
+                    for a in node.names:
+                        if a.name in METRIC_CLASSES:
+                            metric_class_aliases.add(a.asname or a.name)
+
+        is_metrics_module = rel.endswith("utils/metrics.py")
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not is_metrics_module and (
+                (isinstance(fn, ast.Name) and fn.id in metric_class_aliases)
+                or (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in METRIC_CLASSES
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in metrics_module_aliases
+                )
+            ):
+                cls = fn.id if isinstance(fn, ast.Name) else fn.attr
+                flag(
+                    node.lineno,
+                    f"direct {cls}(...) construction; mint "
+                    "through a Registry / the utils.metrics helpers instead",
+                )
+                continue
+            if (
+                not is_metrics_module
+                and isinstance(fn, ast.Attribute)
+                and fn.attr == "with_labels"
+            ):
+                bounded = {
+                    kw.arg for kw in node.keywords if kw.arg in CENTRAL_LABELS
+                }
+                for kw in node.keywords:
+                    if kw.arg is None and isinstance(kw.value, ast.Dict):
+                        bounded.update(
+                            k.value for k in kw.value.keys
+                            if isinstance(k, ast.Constant)
+                            and k.value in CENTRAL_LABELS
+                        )
+                if bounded:
+                    labels = ", ".join(sorted(bounded))
+                    flag(
+                        node.lineno,
+                        f".with_labels({labels}=...) outside "
+                        "utils/metrics.py; mint bounded labels through the "
+                        "central helpers (model_registry / "
+                        "slo_model_window_metrics / trace_retention_metrics)",
+                    )
+                    continue
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("inc", "set")
+                and any(kw.arg == "exemplar" for kw in node.keywords)
+            ):
+                flag(
+                    node.lineno,
+                    f"exemplar= on .{fn.attr}(); exemplars "
+                    "attach to histogram observe() only (non-histogram series "
+                    "cannot carry them)",
+                )
+                continue
+            if isinstance(fn, ast.Attribute) and fn.attr in MINT_METHODS:
+                arg = _name_arg(node)
+                if arg is None:
+                    continue
+                head = literal_head(arg)
+                if head is None:
+                    flag(
+                        node.lineno,
+                        f".{fn.attr}() with a non-literal "
+                        "metric name; names must be statically auditable",
+                    )
+                elif not head.startswith(METRIC_PREFIX):
+                    flag(
+                        node.lineno,
+                        f"metric name {head!r} is not "
+                        f"{METRIC_PREFIX}-prefixed",
+                    )
+                elif not is_metrics_module and (
+                    any(head.startswith(p) for p in CENTRAL_PREFIXES)
+                    or head in CENTRAL_NAMES
+                ):
+                    flag(
+                        node.lineno,
+                        f"{head!r} minted outside "
+                        "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
+                        "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_* series (and "
+                        "kdlt_engine_warm_source) are minted only by the central "
+                        "helpers (bounded label sets by construction)",
+                    )
+        return violations
