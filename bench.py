@@ -3609,6 +3609,220 @@ def bench_quant_ab(reps=3, size=32, buckets=(1, 2), calib_images=8,
     return out, 0 if ok else 1
 
 
+def bench_mesh_ab(reps=3, size=96, buckets=(8, 16), arms=(1, 2, 4), seed=0,
+                  tol=1e-4, bytes_slack=0.15, floor_frac=0.2):
+    """Model-parallel serving A/B on the 2-D named-sharding mesh.
+
+    One InferenceEngine per arm serves the same random-init ViT-S/16
+    weights over an 8-virtual-device CPU mesh shaped (8/mp, mp) for mp in
+    ``arms``: mp=1 is the replicated (pure data-parallel) baseline, mp>1
+    shards the qkv/mlp kernels over the model axis per
+    parallel.mesh.PARTITION_RULES.  A transformer family on purpose: its
+    params are almost entirely wide dense kernels, so the per-device
+    param-byte shrink can actually approach 1/mp (a depthwise-separable
+    tower keeps its convs replicated and could never show it).
+
+    Per arm the record carries per-bucket img/s, the per-device resident
+    param bytes (parallel.mesh.param_bytes_per_device over the engine's
+    sharded tree), the compiled program's own per-device argument bytes
+    (jit .lower().compile().memory_analysis() -- XLA's account, not ours),
+    and logit drift vs the mp=1 arm on seeded fixtures.
+
+    rc=0 iff every mp>1 arm (a) agrees with the replicated arm within
+    ``tol`` relative max-abs drift, (b) shrinks per-device param bytes to
+    <= 1/mp + ``bytes_slack``, and (c) holds >= ``floor_frac`` of the
+    mp=1 arm's img/s at the two largest buckets (collectives over host
+    ICI-stand-in memory are not free; the floor catches a catastrophic
+    layout, not a speedup claim), and the kdlt_mesh_* series landed on the
+    engine registry.
+    """
+    # The 8 virtual CPU devices must exist before the first BACKEND
+    # INITIALIZATION (the first jax.devices() call), not the first import
+    # -- bench.py's own module imports pull jax in transitively, but
+    # XLA_FLAGS is read lazily at backend bring-up, so setting it here
+    # still works as long as nothing has touched a device yet (--mesh-ab
+    # runs INSTEAD of the sweep, so nothing has).  An inherited
+    # device-count flag is respected.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    from kubernetes_deep_learning_tpu.export.artifact import ModelArtifact
+    from kubernetes_deep_learning_tpu.models import init_variables
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.parallel import mesh as mesh_lib
+    from kubernetes_deep_learning_tpu.runtime import InferenceEngine
+    from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
+
+    n_dev = len(jax.devices())
+    arms = tuple(
+        mp for mp in sorted(set(int(a) for a in arms))
+        if mp >= 1 and n_dev % mp == 0
+    )
+    if len(arms) < 2 or 1 not in arms:
+        out = {
+            "metric": "mesh model-parallel A/B",
+            "error": (
+                f"need the mp=1 baseline plus at least one mp>1 arm on "
+                f"{n_dev} devices (arms resolved to {list(arms)}; was jax "
+                "imported before the device-count flag could be set?)"
+            ),
+        }
+        return out, 1
+    buckets = tuple(sorted(set(int(b) for b in buckets)))
+    spec = register_spec(
+        ModelSpec(
+            name="mesh-ab",
+            family="vit-s16",
+            input_shape=(size, size, 3),
+            labels=tuple(f"c{i}" for i in range(10)),
+            preprocessing="tf",
+        )
+    )
+    log(
+        f"mesh A/B: vit-s16 @{size}x{size} on {n_dev} devices, arms "
+        f"mp={list(arms)}, buckets {list(buckets)}, {reps} reps/bucket, "
+        f"tol {tol:g}, bytes slack {bytes_slack:g}, floor {floor_frac:g}"
+    )
+    variables = jax.tree_util.tree_map(np.asarray, init_variables(spec, seed=1))
+    rng = np.random.default_rng(seed)
+    fixtures = {
+        b: rng.integers(0, 256, size=(b, *spec.input_shape), dtype=np.uint8)
+        for b in buckets
+    }
+    # float32 on every arm: the comparison is sharding noise, not bf16 noise.
+    meta = {"compute_dtype": "float32"}
+    results: dict[str, dict] = {}
+    golden: dict[int, dict[int, np.ndarray]] = {}
+    metrics_ok = True
+    for mp in arms:
+        registry = metrics_lib.Registry()
+        mesh = mesh_lib.make_mesh(
+            n_dev, model_parallel=mp, devices=jax.devices()
+        )
+        eng = InferenceEngine(
+            ModelArtifact(spec, variables, None, dict(meta)),
+            buckets=buckets, use_exported=False, mesh=mesh,
+            registry=registry, fast=False,
+        )
+        warm_s = eng.warmup()
+        if eng.buckets != buckets:
+            # make_mesh grouped (8/mp, mp): every bucket here is a multiple
+            # of each arm's data-axis size, so the engine's rounding must be
+            # a no-op -- rounded ladders would bench different shapes.
+            raise AssertionError(
+                f"mp={mp}: engine rounded buckets {buckets} -> {eng.buckets}"
+            )
+        info = eng.sharding_info()
+        golden[mp] = {b: eng.predict(fixtures[b]) for b in buckets}
+        # XLA's own per-device account of the compiled program's arguments
+        # at the largest bucket (donated batch + resident params).
+        compiled_arg_bytes = None
+        try:
+            ma = (
+                eng._jitted.lower(eng._variables, fixtures[buckets[-1]])
+                .compile()
+                .memory_analysis()
+            )
+            compiled_arg_bytes = int(ma.argument_size_in_bytes)
+        except Exception as e:  # noqa: BLE001 - reporting extra, not gated
+            log(f"  mp={mp}: no compiled memory analysis ({e})")
+        per_bucket = {}
+        for b in buckets:
+            x = fixtures[b]
+            eng.predict(x)  # warm the timing path
+            t1 = time.perf_counter()
+            for _ in range(reps):
+                eng.predict(x)
+            dt = (time.perf_counter() - t1) / reps
+            per_bucket[b] = {"img_per_s": round(b / dt, 2)}
+        if "kdlt_mesh_model_parallel" not in registry.render():
+            metrics_ok = False
+        results[str(mp)] = {
+            "mesh_shape": info["mesh_shape"],
+            "sharding": info["sharding"],
+            "warmup_s": round(warm_s, 2),
+            "param_bytes_per_device": info["param_bytes_per_device"],
+            "compiled_argument_bytes_per_device": compiled_arg_bytes,
+            "buckets": per_bucket,
+        }
+        log(
+            f"  mp={mp}: warmup {warm_s:5.1f}s  params/dev "
+            f"{info['param_bytes_per_device'] / 1e6:7.2f} MB  "
+            + "  ".join(
+                f"b{b}: {per_bucket[b]['img_per_s']:8.2f} img/s"
+                for b in buckets
+            )
+        )
+
+    base = results["1"]
+    base_bytes = base["param_bytes_per_device"]
+    gate_arms: dict[str, dict] = {}
+    ok = metrics_ok
+    for mp in arms:
+        if mp == 1:
+            continue
+        arm = results[str(mp)]
+        drift = max(
+            float(
+                np.abs(golden[1][b] - golden[mp][b]).max()
+                / (np.abs(golden[1][b]).max() + 1e-9)
+            )
+            for b in buckets
+        )
+        bytes_ratio = arm["param_bytes_per_device"] / max(base_bytes, 1)
+        floors = {}
+        for b in buckets[-2:]:
+            ref = base["buckets"][b]["img_per_s"]
+            floors[str(b)] = round(
+                arm["buckets"][b]["img_per_s"] / max(ref, 1e-9), 3
+            )
+        arm_ok = (
+            drift <= tol
+            and bytes_ratio <= 1.0 / mp + bytes_slack
+            and all(f >= floor_frac for f in floors.values())
+        )
+        gate_arms[str(mp)] = {
+            "rel_maxabs_drift": round(drift, 7),
+            "bytes_ratio": round(bytes_ratio, 4),
+            "bytes_bound": round(1.0 / mp + bytes_slack, 4),
+            "throughput_frac": floors,
+            "ok": arm_ok,
+        }
+        ok = ok and arm_ok
+        log(
+            f"  mp={mp} vs mp=1: drift {drift:.2e} (tol {tol:g}), "
+            f"bytes {bytes_ratio:.3f}x (bound "
+            f"{1.0 / mp + bytes_slack:.3f}), throughput "
+            + " ".join(f"b{b}: {f:.2f}x" for b, f in floors.items())
+            + f" (floor {floor_frac:g}) -> {'ok' if arm_ok else 'FAIL'}"
+        )
+    if not metrics_ok:
+        log("  kdlt_mesh_* series MISSING from the engine registry")
+    biggest = max(a for a in arms if a > 1)
+    out = {
+        "metric": (
+            f"mesh model-parallel A/B (vit-s16 @{size}, {n_dev} devices, "
+            f"buckets {list(buckets)}): per-device param bytes and logit "
+            f"parity vs the replicated mp=1 arm"
+        ),
+        "value": gate_arms[str(biggest)]["bytes_ratio"],
+        "unit": f"x per-device param bytes (mp={biggest} / mp=1)",
+        "vs_baseline": gate_arms[str(biggest)]["bytes_ratio"],
+        "tol": tol,
+        "bytes_slack": bytes_slack,
+        "floor_frac": floor_frac,
+        "seed": seed,
+        "mesh_metrics_present": metrics_ok,
+        "arms": results,
+        "gate": gate_arms,
+    }
+    return out, 0 if ok else 1
+
+
 def bench_cache_ab(duration_s=6.0, device_ms=50.0, deadline_ms=800.0,
                    rate_rps=60.0, zipf_alpha=1.1, universe=64, probe_n=16,
                    seed=0):
@@ -4495,6 +4709,55 @@ def main() -> int:
         help="seed for --quant-ab fixtures and calibration stream",
     )
     p.add_argument(
+        "--mesh-ab", type=int, default=0, metavar="REPS",
+        help="INSTEAD of the sweep: model-parallel mesh serving A/B -- one "
+             "InferenceEngine per mp arm on an 8-virtual-device CPU mesh "
+             "shaped (8/mp, mp), vit-s16 weights shared across arms, this "
+             "many timed reps per bucket.  rc=0 iff every mp>1 arm matches "
+             "the replicated mp=1 arm's logits within --mesh-tol, shrinks "
+             "per-device param bytes to <= 1/mp + --mesh-bytes-slack, "
+             "holds >= --mesh-floor of mp=1 img/s at the two largest "
+             "buckets, and the kdlt_mesh_* series landed on the registry",
+    )
+    p.add_argument(
+        "--mesh-size", type=int, default=96,
+        help="square input size for --mesh-ab (must be a multiple of the "
+             "ViT patch size 16)",
+    )
+    p.add_argument(
+        "--mesh-buckets", default="8,16",
+        help="bucket ladder for --mesh-ab (each entry must be a multiple "
+             "of every arm's data-axis size so all arms bench the same "
+             "shapes)",
+    )
+    p.add_argument(
+        "--mesh-arms", default="1,2,4",
+        help="model-parallel degrees for --mesh-ab (must include 1, the "
+             "replicated baseline; each must divide the device count)",
+    )
+    p.add_argument(
+        "--mesh-tol", type=float, default=1e-4,
+        help="relative max-abs logit drift bound vs the mp=1 arm for "
+             "--mesh-ab (sharded matmuls reassociate float sums; measured "
+             "drift on CPU f32 is ~1e-6)",
+    )
+    p.add_argument(
+        "--mesh-bytes-slack", type=float, default=0.15,
+        help="additive slack on the 1/mp per-device param-byte bound for "
+             "--mesh-ab (embeddings/layernorms/biases stay replicated)",
+    )
+    p.add_argument(
+        "--mesh-floor", type=float, default=0.2,
+        help="min fraction of the mp=1 arm's img/s an mp>1 arm must hold "
+             "at the two largest buckets for --mesh-ab (catastrophic-"
+             "layout catch, not a speedup claim: virtual CPU devices "
+             "share one memory bus)",
+    )
+    p.add_argument(
+        "--mesh-seed", type=int, default=0,
+        help="seed for the --mesh-ab fixtures",
+    )
+    p.add_argument(
         "--chaos-ab", type=float, default=0, metavar="SECONDS",
         help="INSTEAD of the sweep: serving-path fault-tolerance A/B -- "
              "front two stub model-tier replicas with the real gateway, "
@@ -4743,7 +5006,7 @@ def main() -> int:
                      "batcher_sweep", "host_saturation", "overload_ab",
                      "chaos_ab", "churn_ab", "cache_ab", "trace_breakdown",
                      "multimodel_ab", "obs_overhead_ab", "quant_ab",
-                     "tenant_ab", "incident_ab"):
+                     "tenant_ab", "incident_ab", "mesh_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -4838,6 +5101,16 @@ def main() -> int:
                 "b_rps": args.tenant_b_rps,
                 "flood_s": args.tenant_flood_s,
                 "seed": args.tenant_seed,
+            },
+            "mesh": {
+                "reps": args.mesh_ab,
+                "size": args.mesh_size,
+                "buckets": [int(b) for b in args.mesh_buckets.split(",")],
+                "arms": [int(a) for a in args.mesh_arms.split(",")],
+                "tol": args.mesh_tol,
+                "bytes_slack": args.mesh_bytes_slack,
+                "floor_frac": args.mesh_floor,
+                "seed": args.mesh_seed,
             },
             "crosshost": {
                 "rounds": args.crosshost_ab,
@@ -4987,6 +5260,20 @@ def main() -> int:
             b_rps=args.tenant_b_rps,
             flood_s=args.tenant_flood_s,
             seed=args.tenant_seed,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.mesh_ab > 0:
+        out, rc = bench_mesh_ab(
+            reps=args.mesh_ab,
+            size=args.mesh_size,
+            buckets=tuple(int(b) for b in args.mesh_buckets.split(",")),
+            arms=tuple(int(a) for a in args.mesh_arms.split(",")),
+            seed=args.mesh_seed,
+            tol=args.mesh_tol,
+            bytes_slack=args.mesh_bytes_slack,
+            floor_frac=args.mesh_floor,
         )
         print(json.dumps(out), flush=True)
         return rc

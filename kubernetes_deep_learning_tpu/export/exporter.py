@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from kubernetes_deep_learning_tpu.export import artifact as art
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec, get_spec
 from kubernetes_deep_learning_tpu.models import build_forward, init_variables
+from kubernetes_deep_learning_tpu.parallel import mesh as mesh_lib
 
 DEFAULT_PLATFORMS = ("cpu", "tpu")
 
@@ -125,6 +126,13 @@ def export_model(
         "compute_dtype": jnp.dtype(dtype).name,
         "params_dtype": jnp.dtype(params_dtype).name if params_dtype is not None else None,
         "framework_version": __import__("kubernetes_deep_learning_tpu").__version__,
+        # Partition-rule provenance: the family rule a mesh-serving replica
+        # will resolve for this artifact (parallel.mesh.PARTITION_RULES) at
+        # the framework version that exported it.  Purely informational --
+        # the engine re-resolves at load time -- but it lets an operator
+        # see from the artifact alone whether (and which leaves of) a model
+        # shards over the model axis.
+        "partition_rule": dict(mesh_lib.partition_rule(spec.family)),
     }
     # Write-then-rename so a concurrently polling model server (its version
     # watcher scans every few seconds) can never observe a half-written

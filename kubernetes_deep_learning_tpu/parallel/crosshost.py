@@ -105,6 +105,21 @@ from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 _SHUTDOWN, _PREDICT, _RELOAD, _PREDICT_FAST = 0, 1, 2, 3
+# Compressed-payload variants of the two predict flags (the cross-host
+# payload diet): same control header, payload = 1 codec byte + compressed
+# batch bytes.  The FLAG is the negotiation -- the leader resolves
+# $KDLT_XH_COMPRESS once and every follower dispatches on the flag it
+# receives, so a fleet needs no config agreement for this knob, and with
+# compression off the wire (legacy flags, raw payload) is byte-identical
+# to pre-diet builds.
+_PREDICT_Z, _PREDICT_FAST_Z = 4, 5
+
+# Broadcast payload codec: "", "0", "off", "none" -> raw legacy wire;
+# "1"/"on"/"zlib" -> zlib level 1 (stdlib, fast, padded uint8 batches
+# compress well -- the pad rows are pure zeros); "lz4" -> lz4.frame when
+# the package is importable, degrading to zlib on stdlib-only containers.
+XH_COMPRESS_ENV = "KDLT_XH_COMPRESS"
+_XH_CODEC_ZLIB, _XH_CODEC_LZ4 = 1, 2
 
 # Watchdog slack for rounds that include a compile: the first round per
 # (mode, bucket) after an install traces+compiles the SPMD program (7-28 s
@@ -142,6 +157,60 @@ def _env_float(name: str, default: float) -> float:
         return float(raw) if raw.strip() else default
     except ValueError:
         return default
+
+
+def resolve_xh_compress(raw: str | None = None) -> str | None:
+    """$KDLT_XH_COMPRESS -> the broadcast payload codec name, or None.
+
+    Leader-side only: the per-round control flag carries the decision to
+    followers (see _PREDICT_Z), so only the leader's environment matters.
+    An unknown value fails loudly at boot -- a typo silently serving
+    uncompressed would defeat the knob without a trace.
+    """
+    value = (os.environ.get(XH_COMPRESS_ENV, "") if raw is None else raw)
+    value = value.strip().lower()
+    if value in ("", "0", "off", "none", "false"):
+        return None
+    if value in ("1", "on", "true", "zlib"):
+        return "zlib"
+    if value == "lz4":
+        try:
+            import lz4.frame  # noqa: F401
+        except ImportError:
+            return "zlib"
+        return "lz4"
+    raise ValueError(
+        f"{XH_COMPRESS_ENV}={value!r}: expected off, zlib, or lz4"
+    )
+
+
+def _compress_payload(codec: str, raw: bytes) -> bytes:
+    """codec byte + compressed blob (the _PREDICT_Z payload layout)."""
+    if codec == "lz4":
+        import lz4.frame
+
+        return bytes((_XH_CODEC_LZ4,)) + lz4.frame.compress(raw)
+    import zlib
+
+    # Level 1: the broadcast is latency-bound, and the zero pad rows of a
+    # partially filled bucket compress to nothing at any level.
+    return bytes((_XH_CODEC_ZLIB,)) + zlib.compress(raw, 1)
+
+
+def _decompress_payload(payload: bytes) -> bytes:
+    """Inverse of _compress_payload, dispatching on the codec byte."""
+    if not payload:
+        raise ValueError("compressed cross-host round with empty payload")
+    codec, blob = payload[0], payload[1:]
+    if codec == _XH_CODEC_LZ4:
+        import lz4.frame
+
+        return lz4.frame.decompress(blob)
+    if codec == _XH_CODEC_ZLIB:
+        import zlib
+
+        return zlib.decompress(blob)
+    raise ValueError(f"unknown cross-host payload codec byte {codec}")
 
 
 # Control-channel wire format: one fixed header per round -- flag (i32),
@@ -463,6 +532,9 @@ class CrossHostForward:
         # crosshost.collective before the SPMD dispatch; None (the inert
         # fast path) unless $KDLT_FAULTS configures rules.
         self._faults = faults_lib.from_env()
+        # Broadcast payload codec (leader-side; carried per round in the
+        # control flag, so followers ignore their own env for this).
+        self._xh_codec = resolve_xh_compress()
         self._metrics: dict | None = None
         # Leader round watchdog: EWMA-based (PR 3 style), armed per
         # (mode, bucket) only after that key's first -- compiling -- round
@@ -530,6 +602,19 @@ class CrossHostForward:
     def inflight_rounds(self) -> int:
         """Rounds dispatched but not yet materialized (<= pipeline_depth)."""
         return self.pipeline_depth - self._slots._value
+
+    def sharding_info(self) -> dict:
+        """The registry status surface's sharding block (same shape as
+        runtime.InferenceEngine.sharding_info): scheme tag, model-parallel
+        degree, and the full mesh axis map."""
+        from kubernetes_deep_learning_tpu.parallel import mesh as mesh_par
+
+        shape = dict(self.mesh.shape)
+        return {
+            "sharding": mesh_par.sharding_scheme("cross-host"),
+            "model_parallel": int(shape.get(mesh_par.MODEL_AXIS, 1)),
+            "mesh_shape": {str(k): int(v) for k, v in shape.items()},
+        }
 
     def attach_metrics(self, registry) -> None:
         """Mint the kdlt_crosshost_* series on ``registry`` (the serving
@@ -719,7 +804,13 @@ class CrossHostForward:
             with self._round_lock:
                 fast = self.resolve_mode() == "fast"
                 key = ("fast" if fast else "exact", bucket)
-                flag = _PREDICT_FAST if fast else _PREDICT
+                raw = batch.tobytes()
+                if self._xh_codec is not None:
+                    flag = _PREDICT_FAST_Z if fast else _PREDICT_Z
+                    payload = _compress_payload(self._xh_codec, raw)
+                else:
+                    flag = _PREDICT_FAST if fast else _PREDICT
+                    payload = raw
                 seq = self._seq
                 self._seq += 1
                 self._watch.begin(seq, key)
@@ -727,7 +818,7 @@ class CrossHostForward:
                 t0 = time.perf_counter()
                 if self._faults is not None:
                     self._faults.fire("crosshost.broadcast")
-                self._send_round(flag, bucket, batch.tobytes())
+                self._send_round(flag, bucket, payload)
                 t1 = time.perf_counter()
                 if self._faults is not None:
                     self._faults.fire("crosshost.collective")
@@ -736,12 +827,16 @@ class CrossHostForward:
                 if self._metrics is not None:
                     self._metrics["broadcast"].observe(t1 - t0)
                     self._metrics["rounds"].inc()
+                    # kdlt-lint: disable=hot-path-sync -- inflight_rounds is a host int (semaphore accounting); no device handle involved, nothing can block
                     self._metrics["inflight"].set(float(self.inflight_rounds))
                 w1 = trace_lib.now_s() if traces else 0.0
                 if traces:
                     for tr in traces:
+                        # raw vs wire bytes: the payload diet's per-round
+                        # receipt (equal when compression is off).
                         tr.record(
-                            "crosshost.broadcast", w0, w1 - w0, bucket=bucket
+                            "crosshost.broadcast", w0, w1 - w0, bucket=bucket,
+                            raw_bytes=len(raw), wire_bytes=len(payload),
                         )
         except BaseException:
             if seq is not None:
@@ -920,7 +1015,12 @@ class CrossHostForward:
                         raise failure[0]
                     self._do_reload(int(aux))
                     continue
-                fast = flag == _PREDICT_FAST
+                if flag in (_PREDICT_Z, _PREDICT_FAST_Z):
+                    # The flag is the codec negotiation; legacy flags carry
+                    # the raw payload untouched (byte-identical wire when
+                    # the leader runs with compression off).
+                    payload = _decompress_payload(payload)
+                fast = flag in (_PREDICT_FAST, _PREDICT_FAST_Z)
                 if fast and not self._fast_possible:
                     # The leader resolved "fast" where this process statically
                     # cannot build it: the fleet is misconfigured (mixed code
@@ -1206,6 +1306,9 @@ class CrossHostEngine:
     @property
     def fast_degraded(self) -> bool:
         return self._xh.fast_degraded
+
+    def sharding_info(self) -> dict:
+        return self._xh.sharding_info()
 
     def warmup(self) -> float:
         import time

@@ -24,60 +24,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_deep_learning_tpu.modelspec import ModelSpec
 from kubernetes_deep_learning_tpu.models import build_forward
+from kubernetes_deep_learning_tpu.parallel import mesh as mesh_lib
 from kubernetes_deep_learning_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
-# Shard a param's last (output-features) dim over the model axis when it is
-# at least this wide and divisible; smaller layers are cheaper replicated.
-_TP_MIN_FEATURES = 512
+# Back-compat alias: the rule definition moved to parallel.mesh (one source
+# of truth, family-aware); this default floor is what the default rule uses.
+_TP_MIN_FEATURES = mesh_lib._DEFAULT_RULE["min_features"]
 
 
 def param_partition_spec(path: tuple, arr, model_parallel: int) -> P:
-    """Partition rule: output-dim sharding for wide kernels, else replicate."""
-    if model_parallel <= 1:
-        return P()
-    last = arr.shape[-1] if getattr(arr, "ndim", 0) >= 2 else 0
-    is_kernel = path and getattr(path[-1], "key", "") == "kernel"
-    if is_kernel and last >= _TP_MIN_FEATURES and last % model_parallel == 0:
-        return P(*([None] * (arr.ndim - 1) + [MODEL_AXIS]))
-    return P()
+    """Partition rule: output-dim sharding for wide kernels, else replicate.
+
+    Thin wrapper over parallel.mesh.leaf_partition_spec with the default
+    (family-agnostic) rule; kept for callers that predate the per-family
+    table.
+    """
+    return mesh_lib.leaf_partition_spec(path, arr, model_parallel)
 
 
-def shard_variables(variables: Any, mesh: Mesh) -> Any:
+def shard_variables(variables: Any, mesh: Mesh, family: str | None = None) -> Any:
     """device_put variables with the partition rules applied.
 
-    On a mesh spanning multiple PROCESSES, each leaf is assembled from
-    per-local-device puts (make_array_from_single_device_arrays) instead
-    of one cross-process device_put: every process already holds the full
-    host tree (identical artifact/seed), and a device_put against
-    non-addressable devices runs a hidden cross-process assert_equal
-    collective per leaf on some jax versions -- a boot-time broadcast of
-    the whole parameter tree over DCN at best, and on the Gloo CPU
-    backend a hard crash (concurrent per-leaf collective programs
-    corrupt the shared TCP pairs).  Local meshes keep the plain (batched,
-    fast) device_put.
+    Computes the per-family rule tree (parallel.mesh.partition_spec) and
+    delegates the placement to parallel.mesh.shard_variables (which owns
+    the multiprocess-safe put).
     """
-    model_parallel = mesh.shape[MODEL_AXIS]
-    me = jax.process_index()
-    multiprocess = any(d.process_index != me for d in mesh.devices.flat)
-    local_devices = [d for d in mesh.devices.flat if d.process_index == me]
-
-    def put(path, arr):
-        spec = param_partition_spec(path, arr, model_parallel)
-        sharding = NamedSharding(mesh, spec)
-        if not multiprocess:
-            return jax.device_put(arr, sharding)
-        arr = np.asarray(arr)
-        imap = sharding.devices_indices_map(arr.shape)
-        return jax.make_array_from_single_device_arrays(
-            arr.shape,
-            sharding,
-            [
-                jax.device_put(np.ascontiguousarray(arr[imap[d]]), d)
-                for d in local_devices
-            ],
-        )
-
-    return jax.tree_util.tree_map_with_path(put, variables)
+    rules = mesh_lib.partition_spec(family, variables, mesh.shape[MODEL_AXIS])
+    return mesh_lib.shard_variables(mesh, variables, rules)
 
 
 def resolve_sharded_fast(spec: ModelSpec, mesh: Mesh, dtype: Any, fast) -> bool:
@@ -165,6 +138,59 @@ def build_sharded_jit(
             NamedSharding(mesh, out_spec),
             NamedSharding(mesh, P()),
         ),
+    )
+
+
+def build_mesh_serving_jit(
+    spec: ModelSpec, mesh: Mesh, dtype: Any, fast: bool,
+    forward=None, donate: bool = False,
+):
+    """The engine's mesh-scheme serving jit: a REAL ``jax.jit`` object.
+
+    Unlike build_sharded_forward's closure this exposes ``.lower()`` -- so
+    ``donation_info`` and the per-device memory audit (``Lowered``
+    ``memory_analysis``) work on mesh engines exactly as on single-device
+    ones.  The host numpy batch is passed straight in: ``in_shardings``
+    commits it to P(data) on the transfer path, the ``None`` entry keeps
+    the params' committed (load-time) shardings, and ``out_shardings``
+    replicates the logits so the all-gather happens ON DEVICE and readback
+    is a local ``np.asarray``.
+
+    ``forward`` overrides the inner function (the engine passes its
+    quantization-aware live forward so int8 leaves ride the sharded
+    layout); ``fast`` wraps the inner under shard_map exactly as
+    build_sharded_jit does.  ``donate=True`` donates the batch argument
+    (argnum 1), composing PR 9's buffer donation with the GSPMD layout.
+    """
+    from kubernetes_deep_learning_tpu.utils.jaxcompat import shard_map
+
+    inner = forward
+    if inner is None:
+        inner = build_forward(spec, dtype=dtype, fast=fast)
+    if fast:
+        # check_vma=False: pallas_call out_shapes do not declare varying
+        # mesh axes, and the data flow here is trivially per-shard.
+        inner = shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS),
+            check_vma=False,
+        )
+    if donate:
+        import warnings
+
+        # A host numpy batch has no device buffer to reuse; jax warns per
+        # call that the donation went unused.  Harmless (the annotation
+        # matters when the batcher hands over a device-resident batch).
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+    return jax.jit(
+        inner,
+        in_shardings=(None, NamedSharding(mesh, P(DATA_AXIS))),
+        out_shardings=NamedSharding(mesh, P()),
+        donate_argnums=(1,) if donate else (),
     )
 
 

@@ -9,8 +9,14 @@ that mesh is defined for both serving (data-parallel predict) and training.
 
 Axis convention:
 - ``data``  -- batch-sharded; serving and the train loop shard along this.
-- ``model`` -- reserved for tensor-parallel param sharding (wide head
-  layers); size 1 in pure data-parallel deployments.
+- ``model`` -- tensor-parallel param sharding (wide dense/conv channel
+  dims); size 1 in pure data-parallel deployments.
+
+This module also owns the partition RULES: per-family thresholds deciding
+which leaves shard over ``model`` (``partition_spec``), the one-shot
+load-time placement (``shard_variables``), and the closed vocabulary of
+sharding-scheme tags the registry/status plane reports
+(``SHARDING_SCHEMES`` / ``sharding_scheme``).
 """
 
 from __future__ import annotations
@@ -21,6 +27,139 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+# Closed vocabulary of sharding-scheme tags (registry status / GET
+# /v1/models / hot-reload bookkeeping key on the exact strings; kdlt-lint's
+# closed-vocab pass checks literal call sites of sharding_scheme()).
+SCHEME_SINGLE = "single"
+SCHEME_MESH_DATA = "mesh-data"
+SCHEME_MESH_SEQUENCE = "mesh-sequence"
+SCHEME_CROSS_HOST = "cross-host"
+SHARDING_SCHEMES = (
+    SCHEME_SINGLE, SCHEME_MESH_DATA, SCHEME_MESH_SEQUENCE, SCHEME_CROSS_HOST,
+)
+
+
+def sharding_scheme(name: str) -> str:
+    """Validate a sharding-scheme tag against the closed vocabulary."""
+    if name not in SHARDING_SCHEMES:
+        raise ValueError(
+            f"unknown sharding scheme {name!r}; known: {SHARDING_SCHEMES}"
+        )
+    return name
+
+
+# Per-family partition rules for the model axis.  ``min_features`` is the
+# floor on a kernel's output-channel width before sharding it pays for the
+# all-reduce it induces.  ``conv`` controls whether conv kernels (ndim > 2)
+# shard at all: depthwise-separable towers (xception, efficientnet) keep
+# their convs replicated -- a feature-sharded activation entering a grouped
+# depthwise conv forces the SPMD partitioner into a full rematerialization
+# (all-gather + re-slice) at EVERY sepconv, which costs more than the
+# sharding saves -- so only their wide dense head shards.  Plain-conv
+# (resnet50) and transformer (vit-*) families shard both; ViT's params are
+# almost entirely qkv/mlp dense kernels, so its floor is low enough that
+# the small configs shard too (the ~1/mp per-device byte shrink the bench
+# gate checks is only reachable on such families).
+_DEFAULT_RULE = {"min_features": 512, "leaves": ("kernel",), "conv": True}
+PARTITION_RULES: dict[str, dict] = {
+    "xception": {"min_features": 512, "leaves": ("kernel",), "conv": False},
+    "efficientnet-*": {"min_features": 512, "leaves": ("kernel",), "conv": False},
+    "resnet50": {"min_features": 512, "leaves": ("kernel",), "conv": True},
+    # qkv: the attention projections are DenseGeneral kernels shaped
+    # (in, heads, head_dim); their output width is the (heads, head_dim)
+    # pair, so they get their own rule (heads axis first, Megatron-style).
+    "vit-*": {
+        "min_features": 128, "leaves": ("kernel",), "conv": True,
+        "qkv": ("query", "key", "value"),
+    },
+}
+
+
+def partition_rule(family: str | None) -> dict:
+    """The partition rule for a model family (exact, then glob, then default)."""
+    if family:
+        got = PARTITION_RULES.get(family)
+        if got is not None:
+            return got
+        for key, rule in PARTITION_RULES.items():
+            if key.endswith("*") and family.startswith(key[:-1]):
+                return rule
+    return _DEFAULT_RULE
+
+
+def leaf_partition_spec(
+    path: tuple, arr, model_parallel: int, min_features: int | None = None,
+    leaves: tuple = ("kernel",), conv: bool = True, qkv: tuple = (),
+) -> P:
+    """Partition rule for one leaf: output-dim shard wide kernels, replicate
+    the rest.
+
+    ``conv=False`` restricts sharding to 2-D (dense) kernels; depthwise
+    kernels (input-channel dim 1, i.e. grouped convs) never shard.
+    Quantized artifacts store each kernel as a ``{_q8, _q8_scale[,
+    _q8_act_scale]}`` subtree (ops.quantize); the int8 payload shards
+    exactly like the float kernel it replaced, the per-output-channel scale
+    vector shards with it (same output dim), and the scalar activation
+    scale replicates -- so w8a8 composes with the mesh layout without a
+    host-side dequantize at load.
+    """
+    if model_parallel <= 1:
+        return P()
+    if min_features is None:
+        min_features = _DEFAULT_RULE["min_features"]
+
+    def kernel_spec(shape, ndim) -> P:
+        width = shape[-1]
+        if width < min_features or width % model_parallel:
+            return P()
+        if ndim > 2 and (not conv or shape[-2] == 1):  # conv off / depthwise
+            return P()
+        return P(*([None] * (ndim - 1) + [MODEL_AXIS]))
+
+    tail = getattr(path[-1], "key", "") if path else ""
+    parent = getattr(path[-2], "key", "") if len(path) >= 2 else ""
+    if parent in leaves:  # inside a quantized-kernel subtree
+        from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+        if tail == quant_lib.QUANT_KEY and getattr(arr, "ndim", 0) >= 2:
+            return kernel_spec(arr.shape, arr.ndim)
+        # _q8_scale / _q8_act_scale stay replicated: a float per output
+        # channel (KBs) -- XLA re-slices it against the sharded int8
+        # payload for free, and replicating sidesteps any kernel/scale
+        # layout mismatch.
+        return P()
+    if tail in leaves and getattr(arr, "ndim", 0) >= 2:
+        if parent in qkv and arr.ndim == 3:
+            # (in, heads, head_dim): shard the heads axis when divisible
+            # (per-head attention parallelism, no cross-shard traffic
+            # inside the attention kernel); fall back to head_dim, where
+            # XLA all-reduces the score contraction instead.
+            heads, head_dim = arr.shape[1], arr.shape[2]
+            if heads * head_dim >= min_features:
+                if heads % model_parallel == 0:
+                    return P(None, MODEL_AXIS, None)
+                if head_dim % model_parallel == 0:
+                    return P(None, None, MODEL_AXIS)
+            return P()
+        return kernel_spec(arr.shape, arr.ndim)
+    return P()
+
+
+def partition_spec(family: str | None, variables, model_parallel: int):
+    """Per-family partition rules -> a pytree of PartitionSpecs matching
+    ``variables`` (wide dense/conv channel dims over MODEL_AXIS, everything
+    else replicated)."""
+    rule = partition_rule(family)
+
+    def spec(path, arr):
+        return leaf_partition_spec(
+            path, arr, model_parallel,
+            min_features=rule["min_features"], leaves=tuple(rule["leaves"]),
+            conv=rule.get("conv", True), qkv=tuple(rule.get("qkv", ())),
+        )
+
+    return jax.tree_util.tree_map_with_path(spec, variables)
 
 
 def make_mesh(
@@ -39,6 +178,58 @@ def make_mesh(
         raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
     grid = np.array(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def shard_variables(mesh: Mesh, variables, rules):
+    """device_put every param to its NamedSharding once at load.
+
+    ``rules`` is a pytree of PartitionSpecs matching ``variables``
+    (partition_spec's output).  On a mesh spanning multiple PROCESSES, each
+    leaf is assembled from per-local-device puts
+    (make_array_from_single_device_arrays) instead of one cross-process
+    device_put: every process already holds the full host tree (identical
+    artifact/seed), and a device_put against non-addressable devices runs a
+    hidden cross-process assert_equal collective per leaf on some jax
+    versions -- a boot-time broadcast of the whole parameter tree over DCN
+    at best, and on the Gloo CPU backend a hard crash (concurrent per-leaf
+    collective programs corrupt the shared TCP pairs).  Local meshes keep
+    the plain (batched, fast) device_put.
+    """
+    me = jax.process_index()
+    multiprocess = any(d.process_index != me for d in mesh.devices.flat)
+    local_devices = [d for d in mesh.devices.flat if d.process_index == me]
+
+    def put(arr, spec):
+        sharding = NamedSharding(mesh, spec)
+        if not multiprocess:
+            return jax.device_put(arr, sharding)
+        arr = np.asarray(arr)
+        imap = sharding.devices_indices_map(arr.shape)
+        return jax.make_array_from_single_device_arrays(
+            arr.shape,
+            sharding,
+            [
+                jax.device_put(np.ascontiguousarray(arr[imap[d]]), d)
+                for d in local_devices
+            ],
+        )
+
+    return jax.tree_util.tree_map(put, variables, rules)
+
+
+def param_bytes_per_device(variables) -> int:
+    """Per-device resident parameter bytes of a sharded (or replicated)
+    tree -- the "fits where it didn't" number kdlt_mesh_param_bytes_per_device
+    reports."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(variables):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(leaf.shape)
+        else:
+            shape = getattr(leaf, "shape", ())
+        total += int(np.prod(shape, dtype=np.int64)) * leaf.dtype.itemsize
+    return total
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

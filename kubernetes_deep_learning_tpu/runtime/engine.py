@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Sequence
 
@@ -626,8 +627,10 @@ class InferenceEngine:
         # MXU's 2x path -- gated at warmup by the golden-logits tolerance
         # check (_run_quant_gate): past $KDLT_QUANT_TOL the engine refuses
         # the int8-activation program and serves weight-only, loudly.
-        # Mesh serving dequantizes at load instead: the partition rules
-        # address float kernel leaves, not the {_q8, _q8_scale} wire form.
+        # Mesh serving composes: the partition rules address the {_q8,
+        # _q8_scale} wire form directly (parallel.mesh.leaf_partition_spec),
+        # so int8 leaves stay int8 in HBM on every chip and the live
+        # forward dequantizes inline inside the sharded jit.
         self._donate = donation_enabled()
         self._quantization = artifact.metadata.get("quantization") or None
         self._quantization_active = self._quantization
@@ -652,13 +655,12 @@ class InferenceEngine:
                     quant_lib.QUANT_SCHEME_ENV, self.spec.name,
                 )
                 self._quantization_active = quant_lib.SCHEME
-            if mesh is not None:
+            if mesh is not None and mesh_mode == "sequence":
                 import dataclasses
 
-                # Host-side numpy dequant: the jnp variant would briefly
-                # materialize the full f32 tree on one device at load.
-                # (w8a8 included: the sharded forward is float -- int8
-                # activations stay a single-device program for now.)
+                # Host-side numpy dequant: longseq's ring forward addresses
+                # float kernel leaves only (params declared replicated), so
+                # sequence-parallel serving still dequantizes at load.
                 self._quantization_active = None
                 artifact = dataclasses.replace(
                     artifact,
@@ -666,6 +668,14 @@ class InferenceEngine:
                         artifact.variables
                     ),
                 )
+        from kubernetes_deep_learning_tpu.parallel import mesh as mesh_par
+
+        if mesh is None:
+            self._sharding_scheme = mesh_par.sharding_scheme("single")
+        elif mesh_mode == "sequence":
+            self._sharding_scheme = mesh_par.sharding_scheme("mesh-sequence")
+        else:
+            self._sharding_scheme = mesh_par.sharding_scheme("mesh-data")
         if mesh is not None:
             import jax.numpy as jnp
 
@@ -686,30 +696,41 @@ class InferenceEngine:
                 sharded_call = build_sequence_parallel_forward(
                     self.spec, mesh, dtype=jnp.dtype(self._compute_dtype)
                 )
-            else:
-                from kubernetes_deep_learning_tpu.parallel.dataparallel import (
-                    build_sharded_forward,
-                    resolve_sharded_fast,
-                    shard_variables,
-                )
+                self._jitted = sharded_call
+                self._jitted_f32 = sharded_call
+                self._f32_lock = threading.Lock()
+                self._init_metrics(registry)
+                return
+            from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                resolve_sharded_fast,
+                shard_variables,
+            )
 
-                self._variables = shard_variables(artifact.variables, mesh)
-                # Mesh serving runs the fused fast path under shard_map
-                # when it resolves (round 2 forfeited the +29% here);
-                # _fast_engaged arms the same warmup degrade as
-                # single-device serving.
-                self._fast_engaged = resolve_sharded_fast(
-                    self.spec, mesh, jnp.dtype(self._compute_dtype), self._fast
-                )
-                self._fast = self._fast_engaged
-                sharded_call = build_sharded_forward(
-                    self.spec,
-                    mesh,
-                    dtype=jnp.dtype(self._compute_dtype),
-                    fast=self._fast,
-                )
-            self._jitted = sharded_call
-            self._jitted_f32 = sharded_call
+            # One device_put per leaf to its NamedSharding, once at load
+            # (parallel.mesh.partition_spec rules, quantized wire form
+            # included -- int8 leaves shard like the kernels they replaced).
+            self._variables = shard_variables(
+                artifact.variables, mesh, family=self.spec.family
+            )
+            # Mesh serving runs the fused fast path under shard_map
+            # when it resolves (round 2 forfeited the +29% here);
+            # _fast_engaged arms the same warmup degrade as
+            # single-device serving.
+            self._fast_engaged = resolve_sharded_fast(
+                self.spec, mesh, jnp.dtype(self._compute_dtype), self._fast
+            )
+            self._fast = self._fast_engaged
+            from kubernetes_deep_learning_tpu.ops import quantize as quant_lib
+
+            if self._quantization_active == quant_lib.SCHEME_W8A8:
+                # Same int8-activation discipline as single-device serving:
+                # the w8a8 program is the exact graph with int8 operands,
+                # gated at warmup; the fused path only re-enters if the
+                # tolerance gate downgrades to weight-only.
+                self._fast_after_downgrade = self._fast
+                self._fast = False
+                self._fast_engaged = False
+            self._build_live_jit()
             self._f32_lock = threading.Lock()
             self._init_metrics(registry)
             return
@@ -816,6 +837,32 @@ class InferenceEngine:
         # (post-gate, post-override), so a downgraded pod is alertable.
         self._m_quant = metrics_lib.quant_metrics(registry)
         self._refresh_scheme_gauge()
+        # Mesh-serving series (kdlt_mesh_*, minted centrally): static layout
+        # facts -- model_parallel degree, per-axis device counts, per-device
+        # resident param bytes (the "fits where it didn't" number) -- plus
+        # cumulative dispatch->sync device seconds, the denominator for
+        # estimating collective overhead against an mp=1 baseline.
+        self._m_mesh = None
+        if self.mesh is not None:
+            from kubernetes_deep_learning_tpu.parallel import mesh as mesh_par
+
+            mesh_shape = dict(self.mesh.shape)
+            self._m_mesh = metrics_lib.mesh_metrics(registry)
+            self._m_mesh["model_parallel"].set(
+                float(mesh_shape.get(mesh_par.MODEL_AXIS, 1))
+            )
+            for axis, gauge in self._m_mesh["axis_devices"].items():
+                gauge.set(float(mesh_shape.get(axis, 0)))
+            self._m_mesh["param_bytes"].set(
+                float(mesh_par.param_bytes_per_device(self._variables))
+            )
+        # Recent admitted-batch sizes per dispatch, feeding the
+        # /debug/profile?audit=buckets padding-waste audit.
+        # guarded-by: GIL -- deque.append is atomic; readers snapshot with list()
+        self._bucket_history: deque[tuple[int, int]] = deque(maxlen=2048)
+        # kdlt-lint: disable=guarded-by -- construction: _init_metrics runs only from __init__, before the engine escapes to any other thread
+        self._audit_flops: dict[int, float | None] = {}  # guarded-by: _audit_flops_lock
+        self._audit_flops_lock = threading.Lock()
         # Warmup provenance (kdlt_engine_warm_source, minted centrally):
         # cache-hit vs live-compile counts per warmed bucket, the scaled
         # pod's zero-cold-start proof.
@@ -862,6 +909,18 @@ class InferenceEngine:
         flax graph and re-warms every bucket rather than killing the model
         (round-2's failure mode: the default TPU config could not boot).
         """
+        if self.mesh is not None:
+            from kubernetes_deep_learning_tpu.parallel import mesh as mesh_par
+
+            if int(dict(self.mesh.shape).get(mesh_par.MODEL_AXIS, 1)) > 1:
+                # Model-axis programs carry cross-device collectives, and
+                # warm_one EXECUTES each bucket program: two executions
+                # racing from different threads can enqueue in different
+                # per-device orders and deadlock the collective rendezvous
+                # (observed wedging the host-platform CPU backend; the
+                # same interleaving hazard exists on any backend).  Serial
+                # warmup costs boot time only, never serving latency.
+                workers = 1
         t0 = time.perf_counter()
         while True:
             failure = self._warm_buckets(max(1, workers))
@@ -921,7 +980,6 @@ class InferenceEngine:
 
         return (
             self._quantization_active == quant_lib.SCHEME_W8A8
-            and self.mesh is None
             and not getattr(self, "_quant_gate_checked", False)
         )
 
@@ -955,10 +1013,25 @@ class InferenceEngine:
         try:
             # The reference IS the fallback program: _live_forward with the
             # weight-only scheme active (inline dequant, same compute dtype).
+            # On a mesh engine the reference runs over the same mesh (the
+            # variables are committed to their NamedShardings; a plain jit
+            # would work, but building it through the mesh builder keeps the
+            # comparison program-for-program with what the fallback serves).
             self._quantization_active = quant_lib.SCHEME
-            ref_fn = jax.jit(
-                self._live_forward(jnp.dtype(self._compute_dtype))
-            )
+            if self.mesh is not None:
+                from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                    build_mesh_serving_jit,
+                )
+
+                ref_fn = build_mesh_serving_jit(
+                    self.spec, self.mesh, jnp.dtype(self._compute_dtype),
+                    fast=False,
+                    forward=self._live_forward(jnp.dtype(self._compute_dtype)),
+                )
+            else:
+                ref_fn = jax.jit(
+                    self._live_forward(jnp.dtype(self._compute_dtype))
+                )
         finally:
             self._quantization_active = prev
         # kdlt-lint: disable=donation-safety -- x is a host numpy batch; donation consumes device-resident jax.Arrays only, a host array is copied at dispatch and stays valid
@@ -1078,21 +1151,7 @@ class InferenceEngine:
         # Surface on /metrics: a silently-degraded pod serves ~20% slower for
         # its lifetime, which operators must be able to alert on.
         self._m_fast_degraded.set(1.0)
-        if self.mesh is not None:
-            import jax.numpy as jnp
-
-            from kubernetes_deep_learning_tpu.parallel.dataparallel import (
-                build_sharded_forward,
-            )
-
-            sharded_call = build_sharded_forward(
-                self.spec, self.mesh, dtype=jnp.dtype(self._compute_dtype),
-                fast=False,
-            )
-            self._jitted = sharded_call
-            self._jitted_f32 = sharded_call
-        else:
-            self._build_live_jit()
+        self._build_live_jit()
         return True
 
     def _build_live_jit(self) -> None:
@@ -1104,6 +1163,23 @@ class InferenceEngine:
         recycled into the program's own working set."""
         import jax.numpy as jnp
 
+        if self.mesh is not None:
+            # The mesh scheme's jit: batch in_sharded P(data), params keep
+            # their committed (possibly tensor-parallel) shardings, logits
+            # replicated on device, batch donated -- a real jax.jit, so
+            # donation_info / memory analysis work identically to the
+            # single-device path.
+            from kubernetes_deep_learning_tpu.parallel.dataparallel import (
+                build_mesh_serving_jit,
+            )
+
+            dtype = jnp.dtype(self._compute_dtype)
+            self._jitted = build_mesh_serving_jit(
+                self.spec, self.mesh, dtype, fast=self._fast,
+                forward=self._live_forward(dtype), donate=self._donate,
+            )
+            self._jitted_f32 = self._jitted
+            return
         self._jitted = _donate_jit(
             self._live_forward(jnp.dtype(self._compute_dtype)), self._donate
         )
@@ -1153,6 +1229,72 @@ class InferenceEngine:
             ),
         }
 
+    @property
+    def sharding(self) -> str:
+        """The engine's sharding-scheme tag (parallel.mesh.SHARDING_SCHEMES)."""
+        return self._sharding_scheme
+
+    def sharding_info(self) -> dict[str, Any]:
+        """The registry/status surface for GET /v1/models: scheme tag,
+        model-parallel degree, mesh shape, per-device resident param bytes."""
+        info: dict[str, Any] = {
+            "sharding": self._sharding_scheme,
+            "model_parallel": 1,
+            "mesh_shape": None,
+        }
+        if self.mesh is not None:
+            from kubernetes_deep_learning_tpu.parallel import mesh as mesh_par
+
+            mesh_shape = dict(self.mesh.shape)
+            info["model_parallel"] = int(mesh_shape.get(mesh_par.MODEL_AXIS, 1))
+            info["mesh_shape"] = {
+                str(axis): int(size) for axis, size in mesh_shape.items()
+            }
+            info["param_bytes_per_device"] = mesh_par.param_bytes_per_device(
+                self._variables
+            )
+        return info
+
+    def bucket_audit(self) -> dict[str, Any]:
+        """Per-bucket padding-waste + FLOPs audit (/debug/profile?audit=
+        buckets): admitted-vs-bucket sizes over the recent dispatch history
+        plus FLOPs/img from the lowered cost analysis (cached, trace-only
+        -- never an XLA compile).  The diagnostic for the roofline gap the
+        MFU gauges leave unexplained: a high padding_waste_ratio means the
+        bucket ladder, not the program, is burning the flops."""
+        hist = list(self._bucket_history)
+        out: dict[str, Any] = {"window": len(hist), "buckets": {}}
+        for b in self.buckets:
+            admitted = [n for bucket, n in hist if bucket == b]
+            total = sum(admitted)
+            out["buckets"][int(b)] = {
+                "batches": len(admitted),
+                "mean_admitted": (total / len(admitted)) if admitted else None,
+                "padding_waste_ratio": (
+                    1.0 - total / (len(admitted) * b) if admitted else None
+                ),
+                "flops_per_image": self._audit_flops_for(b),
+            }
+        return out
+
+    def _audit_flops_for(self, bucket: int) -> float | None:
+        """FLOPs/img for the audit: the MfuAccountant's estimate when its
+        background thread already produced one, else computed once here and
+        cached (same lowering-only analysis)."""
+        got = self._mfu.flops_estimate(bucket)
+        if got is not None:
+            return got
+        with self._audit_flops_lock:
+            if bucket in self._audit_flops:
+                return self._audit_flops[bucket]
+        try:
+            val = self._flops_per_image(bucket)
+        except Exception:  # noqa: BLE001 - exported-only families raise inside
+            val = None
+        with self._audit_flops_lock:
+            self._audit_flops[bucket] = val
+        return val
+
     def _flops_per_image(self, bucket: int) -> float | None:
         """FLOPs/image at one bucket shape, for the live MFU gauges.
 
@@ -1171,7 +1313,10 @@ class InferenceEngine:
         base = build_forward(
             self.spec, dtype=jnp.dtype(self._compute_dtype), fast=False
         )
-        if self._quantization is not None and self.mesh is None:
+        # _quantization_active is None exactly when the variables were
+        # host-dequantized at load (sequence-mesh serving); everywhere else
+        # the tree still carries the {_q8, _q8_scale} wire form.
+        if self._quantization is not None and self._quantization_active is not None:
             from kubernetes_deep_learning_tpu.ops.quantize import (
                 dequantize_variables,
             )
@@ -1256,6 +1401,9 @@ class InferenceEngine:
         bucket = self.bucket_for(n)
         self._m_pad_waste.inc(bucket - n)
         self._mfu.observe(bucket, n, seconds)
+        self._bucket_history.append((bucket, n))
+        if self._m_mesh is not None:
+            self._m_mesh["collective"].inc(seconds)
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """uint8 (N,H,W,C) -> float32 logits (N,num_classes); pads to bucket."""

@@ -204,6 +204,13 @@ class MfuAccountant:
                 self._gauges[bucket] = gauge
         gauge.set(round(mfu * 100.0, 2))
 
+    def flops_estimate(self, bucket: int) -> float | None:
+        """The background worker's FLOPs/image figure for a bucket, if it
+        has been produced (None while pending or when estimation failed);
+        the bucket-shape audit reads this before computing its own."""
+        with self._lock:
+            return self._flops.get(bucket)
+
     def snapshot(self) -> dict:
         """{bucket: mfu_pct} for debugging/tests."""
         with self._lock:

@@ -150,6 +150,12 @@ class Lane:
     The lane survives engine hot-swaps (version reloads replace
     ``engine``; queued units are engine-agnostic until dispatch), which is
     what makes a reload of model A invisible to model B's in-flight work.
+
+    Mesh engines (data-sharded or tensor-parallel, runtime.engine mesh=)
+    are ordinary lanes: the engine already rounded its bucket ladder up to
+    multiples of the DATA-axis size at construction (model_parallel > 1
+    shrinks that axis, not the rounding rule), so max_batch / bucket_for
+    need no sharding awareness here.
     """
 
     def __init__(self, name: str, engine, weight: float, max_delay_s: float,

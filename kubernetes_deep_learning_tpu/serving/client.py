@@ -224,6 +224,47 @@ def render_debug_index(payload: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_bucket_audit(gateway_url: str, timeout: float = 5.0) -> dict:
+    """GET the gateway's /debug/profile?audit=buckets view: every replica's
+    per-bucket padding-waste ratio and compiled FLOPs/img."""
+    import requests
+
+    r = requests.get(
+        f"{gateway_url}/debug/profile?audit=buckets", timeout=timeout
+    )
+    r.raise_for_status()
+    return r.json()
+
+
+def render_bucket_audit(payload: dict) -> str:
+    """ASCII rendering of the merged bucket audit: one row per (replica,
+    model, bucket) -- how much of each compiled program's work is padding,
+    and what a real image costs in it."""
+    lines = [
+        "bucket audit (padding waste = padded slots / bucket capacity):",
+        f"{'replica':<22s} {'model':<14s} {'bucket':>6s} {'batches':>8s} "
+        f"{'mean_n':>7s} {'waste':>7s} {'gflops/img':>11s}",
+    ]
+    for host, body in sorted((payload.get("replicas") or {}).items()):
+        if not isinstance(body, dict) or "error" in body:
+            err = body.get("error") if isinstance(body, dict) else body
+            lines.append(f"{host:<22s} # unreachable: {err}")
+            continue
+        for model, audit in sorted((body.get("models") or {}).items()):
+            for bucket, row in sorted(
+                (audit.get("buckets") or {}).items(), key=lambda kv: int(kv[0])
+            ):
+                flops = row.get("flops_per_image")
+                gflops = f"{flops / 1e9:>11.3f}" if flops else f"{'-':>11s}"
+                lines.append(
+                    f"{host:<22s} {model:<14s} {int(bucket):>6d} "
+                    f"{int(row.get('batches', 0)):>8d} "
+                    f"{(row.get('mean_admitted') or 0.0):>7.1f} "
+                    f"{(row.get('padding_waste_ratio') or 0.0):>7.2%} {gflops}"
+                )
+    return "\n".join(lines)
+
+
 def fetch_pool(gateway_url: str, timeout: float = 5.0) -> dict:
     """GET the gateway's /debug/pool view: membership, per-replica
     health/quarantine/drain state, picks, and the latency EWMA driving
@@ -343,9 +384,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--stats", action="store_true",
         help="after the prediction, print a per-request stats table (the "
-        "gateway's cache disposition and the retry counters) plus one "
+        "gateway's cache disposition and the retry counters), one "
         "row per upstream replica from /debug/pool (state, picks, "
-        "latency EWMA)",
+        "latency EWMA), and the fleet bucket-shape audit from "
+        "/debug/profile?audit=buckets (padding waste, FLOPs/img)",
     )
     p.add_argument(
         "--trace", action="store_true",
@@ -398,6 +440,14 @@ def main(argv: list[str] | None = None) -> int:
             print(render_pool(fetch_pool(args.gateway)), file=sys.stderr)
         except Exception as e:  # noqa: BLE001 - diagnostics only
             print(f"# pool fetch failed: {e}", file=sys.stderr)
+        # Per-bucket rows from /debug/profile?audit=buckets: padding waste
+        # and FLOPs/img per compiled bucket program, fleet-wide -- whether
+        # the bucket ladder fits the traffic shape.
+        try:
+            print(render_bucket_audit(fetch_bucket_audit(args.gateway)),
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 - diagnostics only
+            print(f"# bucket audit fetch failed: {e}", file=sys.stderr)
         # The /debug/ index footer: what else the gateway can tell you
         # (incidents, traces, SLO) without memorizing routes.
         try:

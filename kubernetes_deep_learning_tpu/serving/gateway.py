@@ -1080,6 +1080,14 @@ class Gateway:
                 json.dumps(self.pool.debug_payload()).encode(),
                 "application/json",
             )
+        if path.split("?", 1)[0] == "/debug/profile":
+            # Bucket-shape audit, merged across the fleet: each replica's
+            # per-bucket padding waste and compiled FLOPs/img (the numbers
+            # that say whether the bucket ladder fits the traffic).
+            return (
+                200, json.dumps(self.handle_profile()).encode(),
+                "application/json",
+            )
         if path in ("/debug", "/debug/"):
             # The debug INDEX: every debug surface this tier serves, with
             # a one-line description -- so operators (and kdlt-client
@@ -1128,6 +1136,8 @@ class Gateway:
                 "thresholds, transitions, per-class shed accounting",
                 "/debug/pool": "upstream membership and per-replica "
                 "health/quarantine/drain, picks, latency EWMA",
+                "/debug/profile?audit=buckets": "merged bucket-shape "
+                "audit: per-replica padding waste and FLOPs/img per bucket",
                 "/debug/incidents": "flight-recorder bundles (own + "
                 "replicas'), merged into causal windows",
                 "/debug/incidents/<id>": "one full incident bundle "
@@ -1230,6 +1240,28 @@ class Gateway:
             self.slo.target,
         )
         return payload
+
+    def handle_profile(self) -> dict:
+        """GET /debug/profile?audit=buckets: the merged bucket-shape audit.
+
+        Each model-tier replica's per-bucket padding-waste ratio and
+        compiled FLOPs/img, keyed by replica host -- the fleet view of
+        whether the bucket ladder fits the traffic shape.  An unreachable
+        replica degrades to an error entry, never a failed response.
+        """
+        replicas: dict[str, dict] = {}
+        for replica in self.pool.replicas:
+            try:
+                r = self._session().get(
+                    f"{replica.base}/debug/profile?audit=buckets", timeout=2.0
+                )
+                replicas[replica.host] = (
+                    r.json() if r.status_code == 200
+                    else {"error": f"status {r.status_code}"}
+                )
+            except Exception as e:  # noqa: BLE001 - partial views beat none
+                replicas[replica.host] = {"error": str(e)[:200]}
+        return {"tier": "gateway", "replicas": replicas}
 
     def handle_trace(self, raw_rid: str) -> tuple[int, bytes, str]:
         """GET /debug/trace/<rid>: the MERGED cross-tier waterfall.

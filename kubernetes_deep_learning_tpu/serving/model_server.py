@@ -94,6 +94,23 @@ PROFILE_DIR_ENV = "KDLT_PROFILE_DIR"  # base dir for /debug/profile captures
 # --aot-warm flag runs the same pass and exits (image build / init
 # container).  See export.warm.
 AOT_WARM_ENV = "KDLT_AOT_WARM"
+# Deploy-side default for --model-parallel: devices per tensor-parallel
+# group on the serving mesh's inner (fastest-ICI) axis.  1 = pure
+# data-parallel (the partition rules replicate everything); > 1 shards
+# wide kernels per parallel.mesh.PARTITION_RULES, shrinking per-device
+# param bytes ~1/mp -- the knob that makes a model fit where it didn't.
+MESH_MODEL_PARALLEL_ENV = "KDLT_MESH_MODEL_PARALLEL"
+
+
+def resolve_mesh_model_parallel(explicit: int = 0) -> int:
+    """--model-parallel wins; else $KDLT_MESH_MODEL_PARALLEL; else 1."""
+    if explicit > 0:
+        return explicit
+    raw = os.environ.get(MESH_MODEL_PARALLEL_ENV, "").strip()
+    try:
+        return max(1, int(raw)) if raw else 1
+    except ValueError:
+        return 1
 
 
 class ServedModel:
@@ -1064,6 +1081,16 @@ class ModelServer:
                 """
                 import tempfile
 
+                if self.command == "GET":
+                    # GET /debug/profile?audit=buckets: the bucket-shape
+                    # audit (padding waste + FLOPs/img) -- pure host-side
+                    # bookkeeping, served even where device profiling is
+                    # disabled.
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    if q.get("audit", [""])[0] == "buckets":
+                        return self._send_json(200, server.bucket_audit())
                 if server._profile_base is None:
                     return self._send_json(404, {"error": "profiling disabled"})
                 try:
@@ -1140,8 +1167,20 @@ class ModelServer:
                 "one request id",
                 "/debug/profile?seconds=N": "capture a jax.profiler "
                 "device trace under KDLT_PROFILE_DIR",
+                "/debug/profile?audit=buckets": "per-model bucket-shape "
+                "audit: padding-waste ratio + compiled FLOPs/img per bucket",
             },
         }
+
+    def bucket_audit(self) -> dict:
+        """GET /debug/profile?audit=buckets: every served model's per-bucket
+        padding-waste + FLOPs audit (runtime.engine.bucket_audit)."""
+        models = {}
+        for name, served in self.model_registry.models.items():
+            audit_fn = getattr(served.engine, "bucket_audit", None)
+            if callable(audit_fn):
+                models[name] = audit_fn()
+        return {"tier": "model-server", "models": models}
 
     def _incident_profile(self, seconds: float) -> dict:
         """Flight-recorder profile hook (KDLT_INCIDENT_PROFILE_S > 0): the
@@ -1204,7 +1243,9 @@ def _serve_cross_host(args) -> int:
             f"global devices (got --data-parallel {n}); scale by adding hosts"
         )
     mesh = make_mesh(
-        n, model_parallel=args.model_parallel, devices=jax.devices()[:n]
+        n,
+        model_parallel=resolve_mesh_model_parallel(args.model_parallel),
+        devices=jax.devices()[:n],
     )
     # Every process loads the same artifact (shared storage or identical
     # image) and builds the same CrossHostForward; only the leader binds
@@ -1342,9 +1383,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument(
         "--model-parallel",
         type=int,
-        default=1,
-        help="with --data-parallel: devices per tensor-parallel group on the "
-        "mesh's inner (fastest-ICI) axis; wide kernels shard their output dim",
+        default=0,
+        help="devices per tensor-parallel group on the mesh's inner "
+        "(fastest-ICI) axis; wide kernels shard their output dim per "
+        "parallel.mesh's family rules.  0 = $KDLT_MESH_MODEL_PARALLEL or 1. "
+        "With --data-parallel N the mesh is (N/M data, M model); with "
+        "--data-parallel 0 and M > 1 the mesh spans all local devices",
     )
     p.add_argument(
         "--profile-dir",
@@ -1495,7 +1539,8 @@ def main(argv: list[str] | None = None) -> int:
         return _serve_cross_host(args)
 
     mesh = None
-    if args.data_parallel > 0:
+    model_parallel = resolve_mesh_model_parallel(args.model_parallel)
+    if args.data_parallel > 0 or model_parallel > 1:
         import jax
 
         from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
@@ -1504,9 +1549,11 @@ def main(argv: list[str] | None = None) -> int:
         # handler cannot drive a cross-host SPMD program (every process
         # must enter the same dispatch in lockstep).  Scaling across hosts
         # is replica scaling (the reference's mechanism) or --cross-host.
+        # model_parallel > 1 without an explicit --data-parallel spans all
+        # local devices (the deploy-env KDLT_MESH_MODEL_PARALLEL path).
         mesh = make_mesh(
-            args.data_parallel,
-            model_parallel=args.model_parallel,
+            args.data_parallel or len(jax.local_devices()),
+            model_parallel=model_parallel,
             devices=jax.local_devices(),
         )
 

@@ -192,4 +192,20 @@ class ModelRegistry:
                 engine, "quantization_active",
                 getattr(served.artifact, "metadata", {}).get("quantization"),
             ),
+            # Sharding tag (parallel.mesh.SHARDING_SCHEMES), alongside the
+            # quantization tag: a hot reload rebuilds the engine against
+            # the SAME mesh (ServedModel keeps it), so the tag surviving a
+            # reload is the re-sharding proof, and {model_parallel,
+            # mesh_shape} tell an operator what layout a replica runs.
+            **self._sharding_status(engine),
+        }
+
+    @staticmethod
+    def _sharding_status(engine) -> dict:
+        info_fn = getattr(engine, "sharding_info", None)
+        info = info_fn() if callable(info_fn) else {}
+        return {
+            "sharding": info.get("sharding"),
+            "model_parallel": info.get("model_parallel", 1),
+            "mesh_shape": info.get("mesh_shape"),
         }

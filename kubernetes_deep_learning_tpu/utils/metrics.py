@@ -397,6 +397,45 @@ def crosshost_metrics(registry: "Registry") -> dict:
     }
 
 
+# The mesh axes the per-axis device-count gauge enumerates -- a BOUNDED
+# label set by construction (parallel.mesh's axis convention).
+MESH_AXES = ("data", "model")
+
+
+def mesh_metrics(registry: "Registry") -> dict:
+    """The mesh-serving series (kdlt_mesh_*), one set per engine/version.
+
+    Static layout facts set once at engine construction --
+    ``model_parallel`` (the model-axis degree), per-axis device counts
+    (labelled ``axis``, bounded to MESH_AXES), and per-device resident
+    param bytes (the "fits where it didn't" number, shrinking ~1/mp as the
+    partition rules shard the wide kernels) -- plus cumulative
+    dispatch->sync device seconds, the denominator for estimating the
+    collective overhead a model axis adds over an mp=1 baseline.
+    """
+    return {
+        "model_parallel": registry.gauge(
+            "kdlt_mesh_model_parallel",
+            "model-axis size of the serving mesh (1 = pure data-parallel)",
+        ),
+        "axis_devices": {
+            axis: registry.with_labels(axis=axis).gauge(
+                "kdlt_mesh_axis_devices", "devices along one mesh axis"
+            )
+            for axis in MESH_AXES
+        },
+        "param_bytes": registry.gauge(
+            "kdlt_mesh_param_bytes_per_device",
+            "resident parameter bytes per device under the partition rules",
+        ),
+        "collective": registry.counter(
+            "kdlt_mesh_collective_seconds_total",
+            "cumulative dispatch->sync device seconds on the mesh (includes "
+            "the model-axis collectives XLA inserted)",
+        ),
+    }
+
+
 # Admission control (serving.admission): every way a tier can refuse work,
 # as the ``shed_reason`` label on kdlt_admission_shed_total.  Shared between
 # both tiers so one dashboard query covers the whole path.

@@ -132,6 +132,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--chaos-ab", "6"], "chaos_ab"),
         (["--cache-ab", "6"], "cache_ab"),
         (["--crosshost-ab", "30"], "crosshost_ab"),
+        (["--mesh-ab", "2"], "mesh_ab"),
         (["--obs-overhead-ab", "5"], "obs_overhead_ab"),
         (["--tenant-ab", "5"], "tenant_ab"),
         (["--incident-ab", "6"], "incident_ab"),
@@ -255,6 +256,30 @@ def test_dry_run_crosshost_ab_echoes_the_pipeline_config():
     assert out["crosshost"]["processes"] == 3
     assert out["crosshost"]["depths"] == [1, 2, 4]
     assert out["crosshost"]["host_ms"] == 5.0
+
+
+def test_dry_run_mesh_ab_echoes_the_mesh_config():
+    # The --mesh-ab invocation surface (the 2-D named-sharding mesh
+    # acceptance harness) must keep parsing and echo its resolved knobs
+    # without importing jax or bringing up the 8-way host-platform mesh.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--mesh-ab", "3", "--dry-run",
+         "--mesh-size", "64", "--mesh-buckets", "4,8",
+         "--mesh-arms", "1,2", "--mesh-tol", "1e-3",
+         "--mesh-bytes-slack", "0.2", "--mesh-floor", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "mesh_ab"
+    assert out["mesh"]["reps"] == 3
+    assert out["mesh"]["size"] == 64
+    assert out["mesh"]["buckets"] == [4, 8]
+    assert out["mesh"]["arms"] == [1, 2]
+    assert out["mesh"]["tol"] == 1e-3
+    assert out["mesh"]["bytes_slack"] == 0.2
+    assert out["mesh"]["floor_frac"] == 0.1
 
 
 def test_dry_run_multimodel_ab_echoes_the_scheduler_config():

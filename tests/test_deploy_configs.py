@@ -515,6 +515,46 @@ def test_quant_envs_agree_across_k8s_and_compose():
         del os.environ[QUANT_SCHEME_ENV]
 
 
+def test_mesh_env_agrees_across_k8s_and_compose():
+    """The model-parallel mesh wiring (ISSUE 16): KDLT_MESH_MODEL_PARALLEL
+    rides on BOTH deploy targets (and on both compose replicas) with a
+    value the resolver accepts, and every copy agrees -- the gateway
+    hedges between replicas, and a pair disagreeing on mesh layout would
+    serve different latency/memory profiles under the same artifact."""
+    from kubernetes_deep_learning_tpu.serving.model_server import (
+        MESH_MODEL_PARALLEL_ENV,
+        resolve_mesh_model_parallel,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (container,) = model_dep["spec"]["template"]["spec"]["containers"]
+    k8s_env = {e["name"]: str(e.get("value", "")) for e in container["env"]}
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+    envs = {"k8s/model-server": k8s_env}
+    for svc in ("model-server", "model-server-b"):
+        envs[f"compose/{svc}"] = {
+            k: str(v)
+            for k, v in compose["services"][svc]["environment"].items()
+        }
+    values = {where: env.get(MESH_MODEL_PARALLEL_ENV) for where, env in envs.items()}
+    assert all(v is not None for v in values.values()), (
+        f"{MESH_MODEL_PARALLEL_ENV} missing from some model tier: {values}"
+    )
+    assert len(set(values.values())) == 1, (
+        f"{MESH_MODEL_PARALLEL_ENV} disagrees across the model tiers: {values}"
+    )
+    # The value must parse through the same resolver the server uses, and
+    # the CLI flag must still win over it.
+    os.environ[MESH_MODEL_PARALLEL_ENV] = k8s_env[MESH_MODEL_PARALLEL_ENV]
+    try:
+        mp = resolve_mesh_model_parallel()
+        assert mp >= 1, "mesh knob wired to a nonsense degree"
+        assert resolve_mesh_model_parallel(explicit=4) == 4
+    finally:
+        del os.environ[MESH_MODEL_PARALLEL_ENV]
+
+
 def test_isolation_and_brownout_envs_agree_across_k8s_and_compose():
     """The tenant-isolation wiring (ISSUE 12): per-model admission budgets
     on EVERY tier copy (a replica pair disagreeing on partitioning would

@@ -1,0 +1,204 @@
+"""Model-parallel serving on the 2-D mesh (ISSUE 16): the GSPMD path.
+
+test_sharded_serving covers the data axis; this file covers what the
+model axis adds -- identical logits at a smaller per-device parameter
+footprint, the sharding status surface (GET /v1/models, kdlt_mesh_*),
+the partition rules' composition with quantized subtrees, hot reload
+keeping the layout, and the bucket-shape audit that rides along
+(/debug/profile?audit=buckets at both tiers + the client rendering).
+All on the 8-virtual-device CPU mesh from conftest.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import requests
+
+from kubernetes_deep_learning_tpu.export import artifact as art
+from kubernetes_deep_learning_tpu.export.exporter import export_model
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.parallel import mesh as mesh_lib
+from kubernetes_deep_learning_tpu.parallel.mesh import MODEL_AXIS, P, make_mesh
+from kubernetes_deep_learning_tpu.runtime import InferenceEngine
+from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+
+@pytest.fixture(scope="module")
+def mp_spec() -> ModelSpec:
+    return register_spec(
+        ModelSpec(
+            name="mp-vit",
+            family="vit-tiny",
+            input_shape=(16, 16, 3),
+            labels=("a", "b", "c"),
+            preprocessing="tf",
+            description="test-only model-parallel serving model",
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def artifact_root(mp_spec, tmp_path_factory):
+    root = tmp_path_factory.mktemp("mp-models")
+    export_model(mp_spec, init_variables(mp_spec, seed=0), str(root))
+    return str(root)
+
+
+@pytest.fixture(scope="module")
+def mp_server(artifact_root):
+    server = ModelServer(
+        artifact_root, port=0, buckets=(1, 8), use_batcher=False,
+        mesh=make_mesh(8, model_parallel=2),
+    )
+    server.warmup()
+    server.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def test_model_parallel_matches_data_parallel_at_smaller_footprint(
+    mp_spec, artifact_root
+):
+    """The whole point of the model axis: same logits, ~1/mp of the wide
+    kernels resident per device."""
+    a = art.load_artifact(art.version_dir(artifact_root, mp_spec.name, 1))
+    eng_dp = InferenceEngine(a, buckets=(8,), mesh=make_mesh(8))
+    eng_mp = InferenceEngine(a, buckets=(8,), mesh=make_mesh(8, model_parallel=2))
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(5, *mp_spec.input_shape), dtype=np.uint8)
+    want = eng_dp.predict(images)
+    got = eng_mp.predict(images)
+    # Same compute dtype, different partitioning: GSPMD's reduction order
+    # may differ, the math must not.
+    np.testing.assert_allclose(got, want, atol=5e-2)
+
+    dp, mp = eng_dp.sharding_info(), eng_mp.sharding_info()
+    assert mp["sharding"] == "mesh-data"
+    assert mp["model_parallel"] == 2
+    assert mp["mesh_shape"] == {"data": 4, "model": 2}
+    assert dp["model_parallel"] == 1
+    # vit-tiny's mlp_in kernels (64 -> 256) clear the vit min_features
+    # floor and shard; the footprint must strictly shrink.
+    assert 0 < mp["param_bytes_per_device"] < dp["param_bytes_per_device"]
+
+
+def test_quantized_subtree_shards_with_its_kernel():
+    """w8a8 x mesh composition (the partition rules' quantize contract):
+    the _q8 int8 payload shards exactly like the float kernel it
+    replaced; scale vectors and scalars replicate."""
+    variables = {"params": {
+        "mlp": {"kernel": {
+            "_q8": np.zeros((64, 256), np.int8),
+            "_q8_scale": np.zeros((256,), np.float32),
+            "_q8_act_scale": np.float32(1.0),
+        }},
+        "head": {"kernel": np.zeros((64, 8), np.float32)},
+        "query": {"kernel": np.zeros((64, 4, 32), np.float32)},
+    }}
+    specs = mesh_lib.partition_spec("vit-s16", variables, 2)
+    p = specs["params"]
+    assert p["mlp"]["kernel"]["_q8"] == P(None, MODEL_AXIS)
+    assert p["mlp"]["kernel"]["_q8_scale"] == P()
+    assert p["mlp"]["kernel"]["_q8_act_scale"] == P()
+    # Narrow head stays replicated; qkv shards its heads axis.
+    assert p["head"]["kernel"] == P()
+    assert p["query"]["kernel"] == P(None, MODEL_AXIS, None)
+
+
+def test_served_status_metrics_and_audit(mp_spec, mp_server):
+    base = f"http://localhost:{mp_server.port}"
+    name = mp_spec.name
+
+    # Status surface: GET /v1/models/<name>:status carries the layout.
+    status = requests.get(f"{base}/v1/models/{name}:status", timeout=10).json()
+    assert status["sharding"] == "mesh-data"
+    assert status["model_parallel"] == 2
+    assert status["mesh_shape"] == {"data": 4, "model": 2}
+
+    # One real predict so the audit window has a row.
+    body = {"instances": np.zeros((3, 16, 16, 3), np.uint8).tolist()}
+    r = requests.post(f"{base}/v1/models/{name}:predict", json=body, timeout=60)
+    assert r.status_code == 200, r.text
+
+    # kdlt_mesh_* series on the metrics page.
+    page = requests.get(f"{base}/metrics", timeout=10).text
+    assert "kdlt_mesh_model_parallel" in page
+    assert 'kdlt_mesh_axis_devices{' in page
+    assert "kdlt_mesh_param_bytes_per_device" in page
+
+    # The bucket-shape audit: 3 admitted into the 4-bucket (buckets round
+    # up to the data axis) -> padding waste 1/4 on that bucket.
+    audit = requests.get(f"{base}/debug/profile?audit=buckets", timeout=10).json()
+    assert audit["tier"] == "model-server"
+    buckets = audit["models"][name]["buckets"]
+    row = buckets["4"]
+    assert row["batches"] >= 1
+    assert row["mean_admitted"] == pytest.approx(3.0)
+    assert row["padding_waste_ratio"] == pytest.approx(0.25)
+    # Never-admitted buckets report null, not garbage.
+    assert buckets["8"]["mean_admitted"] is None
+
+
+def test_gateway_merges_the_bucket_audit(mp_spec, mp_server):
+    from kubernetes_deep_learning_tpu.serving.client import render_bucket_audit
+
+    gateway = Gateway(
+        serving_host=f"localhost:{mp_server.port}", model=mp_spec.name, port=0,
+    )
+    gateway.start()
+    try:
+        r = requests.get(
+            f"http://localhost:{gateway.port}/debug/profile", timeout=10
+        )
+        assert r.status_code == 200, r.text
+        merged = r.json()
+        assert merged["tier"] == "gateway"
+        (body,) = merged["replicas"].values()
+        assert mp_spec.name in body["models"]
+        # The client rendering handles both live rows and never-admitted
+        # buckets (None mean/waste) without crashing.
+        text = render_bucket_audit(merged)
+        assert mp_spec.name in text
+        assert "bucket audit" in text
+    finally:
+        gateway.shutdown()
+
+
+def test_render_bucket_audit_marks_unreachable_replicas():
+    from kubernetes_deep_learning_tpu.serving.client import render_bucket_audit
+
+    text = render_bucket_audit({
+        "tier": "gateway",
+        "replicas": {
+            "a:8500": {"tier": "model-server", "models": {"m": {
+                "window": 0,
+                "buckets": {"8": {
+                    "batches": 0, "mean_admitted": None,
+                    "padding_waste_ratio": None, "flops_per_image": None,
+                }},
+            }}},
+            "b:8500": {"error": "status 503"},
+        },
+    })
+    assert "# unreachable: status 503" in text
+    assert " m " in text  # the reachable replica's model row rendered
+
+
+def test_hot_reload_preserves_the_mesh_layout(mp_spec, mp_server, artifact_root):
+    """Dropping a new version must come back warmed on the SAME mesh --
+    a reload silently falling back to single-device would undo the
+    footprint the model axis bought."""
+    export_model(mp_spec, init_variables(mp_spec, seed=2), artifact_root)
+    assert mp_server.poll_versions() == [f"{mp_spec.name} v2"]
+    served = mp_server.models[mp_spec.name]
+    assert served.version == 2
+    info = served.engine.sharding_info()
+    assert info["sharding"] == "mesh-data"
+    assert info["model_parallel"] == 2
+    status = mp_server.model_registry.model_status(mp_spec.name)
+    assert status["model_parallel"] == 2

@@ -1,0 +1,179 @@
+"""The cross-host payload diet (ISSUE 16): codec resolution, payload
+round-trips, and the wire contract.
+
+Everything here is device-free: the codec helpers are pure bytes->bytes,
+and the wire tests drive the leader/follower framing methods unbound over
+a socketpair -- no jax.distributed fleet, no device.  The one contract
+that matters most is pinned explicitly: with compression OFF the wire is
+byte-identical to the pre-diet protocol, so a mixed fleet mid-rollout
+interoperates and the knob cannot regress the default path.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from kubernetes_deep_learning_tpu.parallel.crosshost import (
+    _PREDICT,
+    _PREDICT_FAST,
+    _PREDICT_FAST_Z,
+    _PREDICT_Z,
+    _XH_CODEC_ZLIB,
+    XH_COMPRESS_ENV,
+    CrossHostForward,
+    _compress_payload,
+    _decompress_payload,
+    resolve_xh_compress,
+)
+
+# --- codec resolution ------------------------------------------------------
+
+
+@pytest.mark.parametrize("raw", ["", "0", "off", "none", "false", " OFF "])
+def test_resolve_off_values_mean_raw_wire(raw):
+    assert resolve_xh_compress(raw) is None
+
+
+@pytest.mark.parametrize("raw", ["1", "on", "true", "zlib", " ZLIB "])
+def test_resolve_on_values_mean_zlib(raw):
+    assert resolve_xh_compress(raw) == "zlib"
+
+
+def test_resolve_lz4_degrades_to_zlib_without_the_package():
+    try:
+        import lz4.frame  # noqa: F401
+    except ImportError:
+        assert resolve_xh_compress("lz4") == "zlib"
+    else:
+        assert resolve_xh_compress("lz4") == "lz4"
+
+
+def test_resolve_unknown_value_fails_loudly():
+    # A typo silently serving uncompressed would defeat the knob without a
+    # trace; boot must refuse it.
+    with pytest.raises(ValueError, match=XH_COMPRESS_ENV):
+        resolve_xh_compress("gzip")
+
+
+def test_resolve_reads_the_env_when_no_explicit_value(monkeypatch):
+    monkeypatch.setenv(XH_COMPRESS_ENV, "zlib")
+    assert resolve_xh_compress() == "zlib"
+    monkeypatch.delenv(XH_COMPRESS_ENV)
+    assert resolve_xh_compress() is None
+
+
+# --- payload round-trips ---------------------------------------------------
+
+
+def test_zlib_payload_round_trips():
+    batch = np.random.default_rng(0).integers(
+        0, 255, size=(8, 16, 16, 3), dtype=np.uint8
+    )
+    raw = batch.tobytes()
+    wire = _compress_payload("zlib", raw)
+    assert wire[0] == _XH_CODEC_ZLIB
+    assert _decompress_payload(wire) == raw
+
+
+def test_lz4_payload_round_trips_when_importable():
+    pytest.importorskip("lz4.frame")
+    raw = bytes(range(256)) * 64
+    wire = _compress_payload("lz4", raw)
+    assert _decompress_payload(wire) == raw
+
+
+def test_zero_padding_actually_shrinks():
+    # The economic case for the diet: a partially filled bucket's pad rows
+    # are pure zeros and must compress to (nearly) nothing.
+    rng = np.random.default_rng(1)
+    batch = np.zeros((16, 96, 96, 3), dtype=np.uint8)
+    batch[:2] = rng.integers(0, 255, size=(2, 96, 96, 3), dtype=np.uint8)
+    wire = _compress_payload("zlib", batch.tobytes())
+    assert len(wire) < batch.nbytes / 4
+
+
+def test_decompress_rejects_empty_and_unknown_codec():
+    with pytest.raises(ValueError, match="empty payload"):
+        _decompress_payload(b"")
+    with pytest.raises(ValueError, match="codec byte"):
+        _decompress_payload(bytes((250,)) + b"junk")
+
+
+# --- the wire contract over a real socketpair ------------------------------
+
+
+class _Wire:
+    """Leader + follower framing halves bound to a socketpair -- the
+    methods under test, none of the fleet bring-up."""
+
+    _send_round = CrossHostForward._send_round
+    _recv_round = CrossHostForward._recv_round
+    _recv_exact = CrossHostForward._recv_exact
+
+    def __init__(self, leader_sock, follower_sock):
+        self._followers = [leader_sock]
+        self._ctl_sock = follower_sock
+
+
+@pytest.fixture()
+def wire():
+    a, b = socket.socketpair()
+    try:
+        yield _Wire(a, b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_compressed_round_trips_over_the_wire(wire):
+    batch = np.random.default_rng(2).integers(
+        0, 255, size=(4, 8, 8, 3), dtype=np.uint8
+    )
+    raw = batch.tobytes()
+    wire._send_round(_PREDICT_Z, 4, _compress_payload("zlib", raw))
+    flag, aux, payload = wire._recv_round()
+    assert (flag, aux) == (_PREDICT_Z, 4)
+    got = np.frombuffer(
+        _decompress_payload(payload), dtype=np.uint8
+    ).reshape(batch.shape)
+    np.testing.assert_array_equal(got, batch)
+
+
+def test_off_mode_wire_is_byte_identical_to_the_legacy_protocol(wire):
+    # Pre-diet framing: "<iqq" header (flag, aux, nbytes) + raw batch
+    # bytes.  With the knob off the leader must emit EXACTLY that -- a
+    # follower from a pre-diet build reads the same rounds.
+    batch = np.arange(4 * 6, dtype=np.uint8).reshape(4, 6)
+    raw = batch.tobytes()
+    wire._send_round(_PREDICT, 4, raw)
+    expected = struct.pack("<iqq", _PREDICT, 4, len(raw)) + raw
+    got = wire._ctl_sock.recv(len(expected) + 64)
+    assert got == expected
+
+
+def test_flag_pairs_stay_distinct():
+    # The flag IS the negotiation; the compressed variants must never
+    # collide with the legacy flags a pre-diet follower dispatches on.
+    assert len({_PREDICT, _PREDICT_FAST, _PREDICT_Z, _PREDICT_FAST_Z}) == 4
+    assert _PREDICT_Z not in (_PREDICT, _PREDICT_FAST)
+    assert _PREDICT_FAST_Z not in (_PREDICT, _PREDICT_FAST)
+
+
+def test_follower_dispatch_decompresses_only_flagged_rounds():
+    # The follower-side dispatch rule, as unit arithmetic: _Z flags carry
+    # a codec byte, legacy flags carry the raw batch -- a follower must
+    # dispatch on the received flag, never its own environment.
+    raw = b"\x00" * 128
+    for flag, payload in (
+        (_PREDICT_Z, _compress_payload("zlib", raw)),
+        (_PREDICT_FAST_Z, _compress_payload("zlib", raw)),
+    ):
+        assert _decompress_payload(payload) == raw, flag
+    # And a raw legacy payload would NOT survive the decompressor -- the
+    # flag split is load-bearing, not cosmetic.
+    with pytest.raises(ValueError):
+        _decompress_payload(raw)

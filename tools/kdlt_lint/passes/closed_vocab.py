@@ -10,6 +10,7 @@ recording/firing call site must be a member:
 - fault points        -> ``serving/faults.py``       ``FAULT_POINTS``
 - event kinds         -> ``utils/flightrecorder.py`` ``EVENT_KINDS``
 - incident triggers   -> ``utils/flightrecorder.py`` ``TRIGGER_RULES``
+- sharding schemes    -> ``parallel/mesh.py``        ``SHARDING_SCHEMES``
 
 The registries are extracted from the AST (module-level assignments of
 string-literal collections, with module-level ``NAME = "literal"``
@@ -41,12 +42,15 @@ from kdlt_lint.core import (
 TRACE_MODULE = f"{PACKAGE}/utils/trace.py"
 FAULTS_MODULE = f"{PACKAGE}/serving/faults.py"
 RECORDER_MODULE = f"{PACKAGE}/utils/flightrecorder.py"
+MESH_MODULE = f"{PACKAGE}/parallel/mesh.py"
 
 VOCABS = (
     ("span", TRACE_MODULE, "SPAN_NAMES"),
     ("fault-point", FAULTS_MODULE, "FAULT_POINTS"),
     ("event-kind", RECORDER_MODULE, "EVENT_KINDS"),
     ("trigger", RECORDER_MODULE, "TRIGGER_RULES"),
+    # Sharding-scheme tags (registry status / GET /v1/models key on them).
+    ("sharding", MESH_MODULE, "SHARDING_SCHEMES"),
 )
 
 # Modules whose bare self.record / self._emit / self.fire calls are
@@ -158,6 +162,9 @@ class ClosedVocabPass(LintPass):
             elif meth == "trigger_threshold":
                 if arg0 is not None:
                     member("trigger", arg0, node.lineno, "incident trigger")
+            elif meth == "sharding_scheme":
+                if arg0 is not None:
+                    member("sharding", arg0, node.lineno, "sharding scheme")
             elif meth == "record" and recv_tail is not None:
                 if recv_tail == "recorder" or (
                     recv == ["self"] and SELF_EMITTER_MODULES.get(mod.rel) == "event-kind"

@@ -36,6 +36,10 @@ from kdlt_lint.core import (
 HOT_PATH_ROOTS = (
     (f"{PACKAGE}/runtime/engine.py", "InFlightDispatcher", "submit"),
     (f"{PACKAGE}/runtime/engine.py", "InferenceEngine", "predict_async"),
+    # The mesh/cross-host forward entry: the leader's broadcast+dispatch
+    # half is what overlaps round N+1 with round N's collective, so a host
+    # sync here stalls the whole fleet's pipeline, not one process.
+    (f"{PACKAGE}/parallel/crosshost.py", "CrossHostForward", "predict_async"),
 )
 
 SYNC_NP_FUNCS = {"numpy.asarray", "numpy.array"}

@@ -25,11 +25,11 @@ MINT_METHODS = {"counter", "gauge", "histogram"}
 METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 CENTRAL_LABELS = {
     "model", "window", "class", "reason", "scheme", "source",
-    "stage", "direction", "trigger",
+    "stage", "direction", "trigger", "axis",
 }
 CENTRAL_PREFIXES = (
     "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
-    "kdlt_incident_",
+    "kdlt_incident_", "kdlt_mesh_",
 )
 CENTRAL_NAMES = ("kdlt_engine_warm_source",)
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
@@ -157,8 +157,8 @@ class MetricsNamingPass(LintPass):
                         node.lineno,
                         f"{head!r} minted outside "
                         "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
-                        "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_* series (and "
-                        "kdlt_engine_warm_source) are minted only by the central "
-                        "helpers (bounded label sets by construction)",
+                        "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_*/kdlt_mesh_* "
+                        "series (and kdlt_engine_warm_source) are minted only by "
+                        "the central helpers (bounded label sets by construction)",
                     )
         return violations
