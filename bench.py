@@ -3823,6 +3823,193 @@ def bench_mesh_ab(reps=3, size=96, buckets=(8, 16), arms=(1, 2, 4), seed=0,
     return out, 0 if ok else 1
 
 
+def bench_decode_ab(n_requests=16, slots=4, step_ms=15.0, deadline_ms=2500.0,
+                    ttft_budget_ms=5000.0, seed=0):
+    """Continuous vs static request-boundary batching on the decode lane.
+
+    The generative lane's acceptance gate (GUIDE 10p): one real
+    DecodeEngine (paged KV-cache, donated step program) serves both arms;
+    the ONLY variable is DecodeScheduler's admission policy.  A closed
+    burst of ``n_requests`` generations with mixed prompt lengths (all
+    three prefill buckets) and mixed ``max_new_tokens`` is submitted to
+    each arm under a per-request deadline:
+
+    - **continuous** (Orca-style): freed decode slots are re-filled from
+      the queue at every step, so a short generation retires and hands
+      its slot to a queued request mid-batch;
+    - **static** (the classic serve-then-swap baseline): admission waits
+      for the WHOLE batch to drain, so every wave convoys on its longest
+      member and late-wave requests burn their deadline in the queue.
+
+    A fixed per-step sleep (``step_ms``) stands in for a real LLM's step
+    time -- the toy model steps in ~0.5 ms on CPU, which would hide the
+    scheduling difference the A/B exists to measure; the sleep slows both
+    arms identically and leaves the computed tokens untouched.
+
+    rc=0 iff (1) the continuous arm's in-deadline token goodput beats
+    static, (2) its TTFT p99 is within ``ttft_budget_ms`` (the lane's
+    KDLT_DECODE_TTFT_MS contract), and (3) token streams from the
+    shifting continuous batch are BIT-IDENTICAL to the same prompts
+    decoded solo on the same engine -- one request per prefill bucket is
+    re-decoded alone and compared token-for-token.
+    """
+    import random
+    import threading
+
+    from kubernetes_deep_learning_tpu.runtime import decode as decode_lib
+    from kubernetes_deep_learning_tpu.serving.admission.deadline import Deadline
+
+    class SlowedEngine(decode_lib.DecodeEngine):
+        # Same compiled programs, same tokens -- plus a fixed sleep so
+        # scheduling effects appear at a realistic step granularity.
+        def step_async(self):
+            if step_ms > 0:
+                time.sleep(step_ms / 1e3)
+            return super().step_async()
+
+    engine = SlowedEngine("gen-bench", max_slots=slots)
+    engine.warmup()
+
+    rng = random.Random(seed)
+    prompt_lens = [6, 24, 48]  # one per prefill bucket (16/32/64 with BOS)
+    token_budgets = [8, 16, 24, 40]
+    requests = []
+    for i in range(n_requests):
+        n_chars = prompt_lens[i % len(prompt_lens)]
+        prompt = "".join(chr(97 + rng.randrange(26)) for _ in range(n_chars))
+        requests.append((prompt, token_budgets[i % len(token_budgets)]))
+
+    def run_arm(continuous):
+        sched = decode_lib.DecodeScheduler(engine, continuous=continuous)
+        sched.start()
+        rows = [None] * n_requests
+        threads = []
+
+        def drive(i, prompt, mnt):
+            t0 = time.perf_counter()
+            try:
+                gen = sched.submit(
+                    prompt, mnt, rid=f"req-{i}",
+                    deadline=Deadline(deadline_ms / 1e3),
+                )
+            except Exception as e:  # noqa: BLE001 - recorded as a lost row
+                rows[i] = {"tokens": [], "ttft_ms": None,
+                           "finish": f"submit:{e}"}
+                return
+            tokens = []
+            ttft_ms = None
+            finish = "?"
+            for ev in gen.iter_events(timeout_s=120.0):
+                if ev[0] == "token":
+                    if not tokens:
+                        ttft_ms = (time.perf_counter() - t0) * 1e3
+                    tokens.append(ev[2])
+                else:
+                    finish = ev[1]
+            rows[i] = {"tokens": tokens, "ttft_ms": ttft_ms, "finish": finish}
+
+        t0 = time.perf_counter()
+        for i, (prompt, mnt) in enumerate(requests):
+            t = threading.Thread(target=drive, args=(i, prompt, mnt))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=180.0)
+        wall = time.perf_counter() - t0
+        sched.close()
+        in_deadline = [
+            r for r in rows
+            if r is not None and r["finish"] in ("stop", "length")
+        ]
+        ttfts = sorted(
+            r["ttft_ms"] for r in rows
+            if r is not None and r["ttft_ms"] is not None
+        )
+        tokens_in_deadline = sum(len(r["tokens"]) for r in in_deadline)
+        return rows, {
+            "wall_s": round(wall, 3),
+            "completed_in_deadline": len(in_deadline),
+            "expired": sum(
+                1 for r in rows if r is not None and r["finish"] == "deadline"
+            ),
+            "tokens_in_deadline": tokens_in_deadline,
+            "token_goodput_per_s": round(tokens_in_deadline / wall, 1),
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 1)
+            if ttfts else None,
+            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 1)
+            if ttfts else None,
+        }
+
+    log(
+        f"decode A/B: {n_requests} generations (prompts {prompt_lens} chars, "
+        f"{token_budgets} new tokens, cycled), {slots} slots, "
+        f"{step_ms:g} ms/step, deadline {deadline_ms:g} ms per request"
+    )
+    cont_rows, cont = run_arm(continuous=True)
+    static_rows, static = run_arm(continuous=False)
+    for name, arm in (("continuous", cont), ("static", static)):
+        log(
+            f"  {name:<11s}: {arm['tokens_in_deadline']:4d} in-deadline "
+            f"tokens in {arm['wall_s']:6.3f}s "
+            f"({arm['token_goodput_per_s']:7.1f} tok/s), "
+            f"{arm['completed_in_deadline']}/{n_requests} completed, "
+            f"{arm['expired']} expired, ttft p99 "
+            f"{arm['ttft_p99_ms'] if arm['ttft_p99_ms'] is not None else '-'} ms"
+        )
+
+    # Bit-exactness: one continuous-arm stream per prefill bucket, decoded
+    # again ALONE on the same engine; every token must match (the same
+    # compiled step program serves every batch composition).
+    exact = True
+    for i in range(min(len(prompt_lens), n_requests)):
+        row = cont_rows[i]
+        if row is None or row["finish"] not in ("stop", "length"):
+            continue
+        solo = engine.decode_solo(requests[i][0], requests[i][1])
+        if solo[: len(row["tokens"])] != row["tokens"]:
+            exact = False
+            log(f"  BIT-EXACTNESS FAIL req-{i}: batch={row['tokens'][:8]}... "
+                f"solo={solo[:8]}...")
+    goodput_ok = (
+        cont["tokens_in_deadline"] > static["tokens_in_deadline"]
+        or (static["expired"] == 0
+            and cont["tokens_in_deadline"] >= static["tokens_in_deadline"])
+    )
+    ttft_ok = (
+        cont["ttft_p99_ms"] is not None
+        and cont["ttft_p99_ms"] <= ttft_budget_ms
+    )
+    ok = goodput_ok and ttft_ok and exact
+    log(
+        f"  gates: goodput {'ok' if goodput_ok else 'FAIL'} "
+        f"(cont {cont['tokens_in_deadline']} vs static "
+        f"{static['tokens_in_deadline']} in-deadline tokens), ttft p99 "
+        f"{'ok' if ttft_ok else 'FAIL'} (budget {ttft_budget_ms:g} ms), "
+        f"bit-exact {'ok' if exact else 'FAIL'}"
+    )
+    out = {
+        "metric": (
+            f"decode continuous-batching A/B ({n_requests} mixed-length "
+            f"generations, {slots} slots, {step_ms:g} ms/step, deadline "
+            f"{deadline_ms:g} ms): in-deadline token goodput, continuous "
+            "vs static request-boundary batching"
+        ),
+        "value": cont["token_goodput_per_s"],
+        "unit": "in-deadline tokens/s (continuous arm)",
+        "vs_baseline": round(
+            cont["tokens_in_deadline"] / max(1, static["tokens_in_deadline"]),
+            3,
+        ),
+        "deadline_ms": deadline_ms,
+        "ttft_budget_ms": ttft_budget_ms,
+        "step_ms": step_ms,
+        "seed": seed,
+        "bit_exact_vs_solo": exact,
+        "arms": {"continuous": cont, "static": static},
+    }
+    return out, 0 if ok else 1
+
+
 def bench_cache_ab(duration_s=6.0, device_ms=50.0, deadline_ms=800.0,
                    rate_rps=60.0, zipf_alpha=1.1, universe=64, probe_n=16,
                    seed=0):
@@ -4919,6 +5106,40 @@ def main() -> int:
         help="deterministic seed for the --cache-ab URL schedule",
     )
     p.add_argument(
+        "--decode-ab", type=int, default=0, metavar="REQUESTS",
+        help="INSTEAD of the sweep: generative-lane continuous-batching "
+             "A/B -- drive this many mixed-prompt-length generations "
+             "through one real DecodeEngine (paged KV-cache) under "
+             "continuous (token-boundary slot-fill) vs static "
+             "(request-boundary) admission with per-request deadlines "
+             "(rc=0 iff continuous wins in-deadline token goodput, its "
+             "TTFT p99 lands within the lane's budget, and continuous-"
+             "batch token streams are bit-identical to solo decode)",
+    )
+    p.add_argument(
+        "--decode-slots", type=int, default=4,
+        help="decode batch slots (fixed step width) for --decode-ab",
+    )
+    p.add_argument(
+        "--decode-step-ms", type=float, default=15.0,
+        help="injected per-step sleep for --decode-ab (stands in for a "
+             "real LLM's step time; the toy model steps in ~0.5 ms, which "
+             "would hide the scheduling difference under measurement)",
+    )
+    p.add_argument(
+        "--decode-deadline-ms", type=float, default=2500.0,
+        help="per-generation deadline budget for --decode-ab",
+    )
+    p.add_argument(
+        "--decode-ttft-budget-ms", type=float, default=5000.0,
+        help="TTFT p99 gate for the --decode-ab continuous arm (the "
+             "KDLT_DECODE_TTFT_MS contract)",
+    )
+    p.add_argument(
+        "--decode-seed", type=int, default=0,
+        help="deterministic seed for the --decode-ab prompt fixtures",
+    )
+    p.add_argument(
         "--trace-breakdown", type=int, default=0, metavar="N",
         help="INSTEAD of the sweep: send N traced requests through a stub "
              "gateway->model-server stack and attribute each request's "
@@ -5006,7 +5227,7 @@ def main() -> int:
                      "batcher_sweep", "host_saturation", "overload_ab",
                      "chaos_ab", "churn_ab", "cache_ab", "trace_breakdown",
                      "multimodel_ab", "obs_overhead_ab", "quant_ab",
-                     "tenant_ab", "incident_ab", "mesh_ab"):
+                     "tenant_ab", "incident_ab", "mesh_ab", "decode_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -5112,6 +5333,14 @@ def main() -> int:
                 "floor_frac": args.mesh_floor,
                 "seed": args.mesh_seed,
             },
+            "decode": {
+                "requests": args.decode_ab,
+                "slots": args.decode_slots,
+                "step_ms": args.decode_step_ms,
+                "deadline_ms": args.decode_deadline_ms,
+                "ttft_budget_ms": args.decode_ttft_budget_ms,
+                "seed": args.decode_seed,
+            },
             "crosshost": {
                 "rounds": args.crosshost_ab,
                 "batch": args.crosshost_ab_batch,
@@ -5208,6 +5437,18 @@ def main() -> int:
             device_ms=args.obs_device_ms,
             clients=args.obs_clients,
             rounds=args.obs_rounds,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.decode_ab > 0:
+        out, rc = bench_decode_ab(
+            n_requests=args.decode_ab,
+            slots=args.decode_slots,
+            step_ms=args.decode_step_ms,
+            deadline_ms=args.decode_deadline_ms,
+            ttft_budget_ms=args.decode_ttft_budget_ms,
+            seed=args.decode_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

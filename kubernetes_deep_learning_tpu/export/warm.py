@@ -39,12 +39,45 @@ from kubernetes_deep_learning_tpu.utils import compilecache
 DEFAULT_CACHE_DIR = "/var/cache/kdlt-xla"
 
 
+def warm_decode(engine_factory=None) -> dict:
+    """Warm the generative lane's decode ladder; returns its report dict.
+
+    The decode lane has its own compile grid, disjoint from the image
+    bucket ladder: one prefill program per prompt-length bucket, plus the
+    single fixed-width step program that serves every batch-slot
+    composition (continuous batching admits into a fixed [S]-slot step,
+    so slot count never recompiles -- the grid is buckets x slots wide
+    but only buckets + 1 programs deep).  A scaled pod started with
+    KDLT_DECODE=1 compiles exactly these programs in
+    GenerateLane.warmup(); running them here lands them in the same
+    persistent cache the pod reads.
+    """
+    from kubernetes_deep_learning_tpu.runtime import decode as decode_lib
+    from kubernetes_deep_learning_tpu.serving.generate import (
+        DECODE_MODEL_ENV,
+        DEFAULT_DECODE_MODEL,
+    )
+
+    model = os.environ.get(DECODE_MODEL_ENV) or DEFAULT_DECODE_MODEL
+    engine = (engine_factory or decode_lib.DecodeEngine)(model=model)
+    entry = dict(engine.warmup())
+    # The learned grid: every (prompt bucket, batch slots) cell the two
+    # program families above cover.  Asserted by tests/test_warm.py.
+    entry["grid"] = {
+        "prompt_buckets": [int(b) for b in entry.get("buckets", {})],
+        "slots": int(getattr(engine, "max_slots", 0)),
+    }
+    return entry
+
+
 def warm_models(
     model_root: str,
     buckets=None,
     cache_dir: str | None = None,
     workers: int = 4,
     engine_factory=None,
+    decode: bool | None = None,
+    decode_engine_factory=None,
 ) -> dict:
     """Warm every model under ``model_root``; returns the report dict.
 
@@ -99,6 +132,27 @@ def warm_models(
             "compiled buckets)",
             file=sys.stderr,
         )
+    # The decode ladder rides the same pass when the generative lane is
+    # on (--decode, or KDLT_DECODE=1 -- the same switch the pods read),
+    # so an image baked with the lane enabled boots with prefill + step
+    # programs already cached.
+    from kubernetes_deep_learning_tpu.serving.generate import decode_enabled
+
+    if decode_enabled(decode):
+        t0 = time.perf_counter()
+        try:
+            report["decode"] = warm_decode(decode_engine_factory)
+        except Exception as e:  # noqa: BLE001 - image models still warmed
+            report["decode"] = {"error": str(e)}
+            print(f"kdlt-warm: decode ladder FAILED: {e}", file=sys.stderr)
+        else:
+            grid = report["decode"]["grid"]
+            print(
+                f"kdlt-warm: decode {report['decode'].get('model')}: "
+                f"{round(time.perf_counter() - t0, 3)}s (prefill buckets "
+                f"{grid['prompt_buckets']} x {grid['slots']} slots + step)",
+                file=sys.stderr,
+            )
     return report
 
 
@@ -145,6 +199,12 @@ def main(argv: list[str] | None = None) -> int:
         "the target platform, so warming on cpu only serves cpu pods",
     )
     p.add_argument(
+        "--decode", action="store_true", default=None,
+        help="also warm the generative lane's decode ladder (prompt-length "
+        "buckets x batch slots; default: follows KDLT_DECODE, the same "
+        "switch serving pods read)",
+    )
+    p.add_argument(
         "--json", action="store_true",
         help="print the full warm report as JSON on stdout",
     )
@@ -161,12 +221,15 @@ def main(argv: list[str] | None = None) -> int:
         buckets=buckets,
         cache_dir=args.compile_cache_dir,
         workers=args.workers,
+        decode=args.decode,
     )
     if args.json:
         print(json.dumps(report, indent=2))
     failed = [
         n for n, m in report["models"].items() if "error" in m
     ]
+    if "error" in (report.get("decode") or {}):
+        failed.append("decode")
     if not report["models"]:
         print(f"kdlt-warm: no models under {args.models}", file=sys.stderr)
         return 1

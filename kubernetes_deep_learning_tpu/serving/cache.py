@@ -48,6 +48,7 @@ from kubernetes_deep_learning_tpu.serving.protocol import (  # noqa: F401 - re-e
     ARTIFACT_HASH_HEADER,
     CACHE_BUST_HEADER,
     CACHE_STATUS_HEADER,
+    EVENT_STREAM_CONTENT_TYPE,
 )
 from kubernetes_deep_learning_tpu.utils import metrics as metrics_lib
 
@@ -342,6 +343,22 @@ class ResponseCache:
             return True
         return status in NEGATIVE_STATUSES and self.neg_ttl_s > 0
 
+    def storable_response(self, status: int, ctype: str | None) -> bool:
+        """storable_status plus the content-type guard: a
+        ``text/event-stream`` body is a live connection's transcript, not
+        a value.  Caching one -- or letting singleflight fan it out --
+        would replay the first client's token stream to a second client
+        as a dead recording, with the first stream's TTFT/TPOT stamped in
+        its done event.  The generative lane never routes through the
+        cache front door, but the store predicate refuses the content
+        type outright so no future route can wire a stream into the
+        cache by accident."""
+        if ctype and ctype.strip().lower().startswith(
+            EVENT_STREAM_CONTENT_TYPE
+        ):
+            return False
+        return self.storable_status(status)
+
     def lookup(self, key: str) -> tuple[int, bytes, str] | None:
         """Hit -> (status, body, ctype) and LRU-touch; miss/expired ->
         None (the caller decides whether the miss leads a flight or
@@ -405,7 +422,9 @@ class ResponseCache:
         """Store one cacheable response; returns False when the body alone
         exceeds the whole byte budget, or the status is not storable.
         Negative entries (400/404) live under the short neg_ttl_s."""
-        if len(body) > self.max_bytes or not self.storable_status(status):
+        if len(body) > self.max_bytes or not self.storable_response(
+            status, ctype
+        ):
             return False
         ttl = self.ttl_s if status == 200 else self.neg_ttl_s
         expires = time.monotonic() + ttl if ttl > 0 else float("inf")

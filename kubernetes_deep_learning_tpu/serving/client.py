@@ -333,6 +333,133 @@ def render_slo(payload: dict) -> str:
     return "\n".join(lines)
 
 
+def generate_stream(
+    gateway_url: str,
+    prompt: str,
+    max_new_tokens: int = 16,
+    model: str | None = None,
+    deadline_ms: float | None = None,
+    priority: str | None = None,
+    timeout: float = 120.0,
+    stats: dict | None = None,
+):
+    """POST /generate and yield each SSE event dict AS IT ARRIVES.
+
+    The generative lane's client half: token events stream out of this
+    generator at decode speed (one dict per token: index, token id,
+    text), and the terminal event carries ``done: true`` plus the
+    server-measured TTFT/TPOT for the generation -- the client never has
+    to clock the stream itself.  ``model`` routes to a non-default decode
+    model via ``/generate/<model>``; ``deadline_ms`` and ``priority``
+    propagate exactly like /predict (a mid-stream deadline expiry ends
+    the stream with finish_reason "deadline").  Closing the generator
+    early closes the connection, which cancels the generation all the
+    way down to its decode slot.
+
+    No retries: a generation is not idempotent the way a predict is --
+    resending after a mid-stream failure would re-decode from scratch,
+    so the retry decision belongs to the caller.
+    """
+    import requests
+
+    if stats is None:
+        stats = {}
+    headers: dict[str, str] = {}
+    if deadline_ms is not None:
+        from kubernetes_deep_learning_tpu.serving.admission import DEADLINE_HEADER
+
+        headers[DEADLINE_HEADER] = f"{float(deadline_ms):.1f}"
+    if priority is not None:
+        headers[protocol.PRIORITY_HEADER] = priority
+    path = "/generate" if model is None else f"/generate/{model}"
+    r = requests.post(
+        f"{gateway_url}{path}",
+        json={"prompt": prompt, "max_new_tokens": max_new_tokens},
+        headers=headers,
+        stream=True,
+        timeout=timeout,
+    )
+    from kubernetes_deep_learning_tpu.serving.tracing import REQUEST_ID_HEADER
+
+    stats["request_id"] = r.headers.get(REQUEST_ID_HEADER, "")
+    r.raise_for_status()
+    buf = b""
+    try:
+        for chunk in r.iter_content(chunk_size=None):
+            buf += chunk
+            # Incremental SSE framing: complete ``data: ...\n\n`` frames
+            # yield immediately; a partial tail waits for its next chunk.
+            while b"\n\n" in buf:
+                frame, buf = buf.split(b"\n\n", 1)
+                frame = frame.strip()
+                if not frame.startswith(b"data:"):
+                    continue
+                try:
+                    yield json.loads(frame[len(b"data:"):].strip())
+                except ValueError:
+                    continue
+    finally:
+        r.close()
+
+
+def _fmt_ms(value) -> str:
+    return f"{value:>8.2f}" if isinstance(value, (int, float)) else f"{'-':>8s}"
+
+
+def render_decode_slo(payload: dict) -> str:
+    """ASCII rendering of the fleet's per-token decode view: one row per
+    replica carrying /debug/slo's ``decode`` section -- TTFT/TPOT window
+    percentiles against the lane's budgets, plus live slot and KV-page
+    occupancy.  Accepts either the gateway's merged payload (rows keyed
+    by replica host) or one model server's own /debug/slo."""
+    replicas = payload.get("replicas")
+    if not isinstance(replicas, dict):
+        replicas = {"local": payload}
+    rows = [
+        (host, body["decode"])
+        for host, body in sorted(replicas.items())
+        if isinstance(body, dict) and isinstance(body.get("decode"), dict)
+    ]
+    if not rows:
+        return (
+            "no decode lane on any replica "
+            "(start model servers with --decode / KDLT_DECODE=1)"
+        )
+    lines = [
+        "decode lane (per-token SLOs; ms; window = recent generations):",
+        f"{'replica':<22s} {'model':<14s} {'gens':>5s} {'ttft50':>8s} "
+        f"{'ttft99':>8s} {'tpot50':>8s} {'tpot99':>8s} {'slots':>7s} "
+        f"{'pages':>9s} {'queue':>5s}",
+    ]
+    for host, dec in rows:
+        w = dec.get("window") or {}
+        ttft = w.get("ttft_ms") or {}
+        tpot = w.get("tpot_ms") or {}
+        occ = dec.get("occupancy") or {}
+        lines.append(
+            f"{host:<22s} {dec.get('model', '?'):<14s} "
+            f"{int(w.get('generations', 0)):>5d} "
+            f"{_fmt_ms(ttft.get('p50'))} {_fmt_ms(ttft.get('p99'))} "
+            f"{_fmt_ms(tpot.get('p50'))} {_fmt_ms(tpot.get('p99'))} "
+            f"{occ.get('active_slots', 0):>3d}/{occ.get('max_slots', 0):<3d} "
+            f"{occ.get('pages_in_use', 0):>4d}/{occ.get('pages_total', 0):<4d} "
+            f"{int(occ.get('queue_depth', 0)):>5d}"
+        )
+        budgets = dec.get("budgets_ms") or {}
+        if budgets:
+            lines.append(
+                f"{'':<22s} # budgets: ttft <= {budgets.get('ttft', 0):g} ms, "
+                f"tpot <= {budgets.get('tpot', 0):g} ms; finish reasons: "
+                + (", ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(
+                        (dec.get("finish_reasons") or {}).items()
+                    )
+                ) or "-")
+            )
+    return "\n".join(lines)
+
+
 def predict_images(
     server_url: str, model: str, images: np.ndarray, timeout: float = 30.0
 ) -> tuple[np.ndarray, list[str]]:
@@ -399,12 +526,64 @@ def main(argv: list[str] | None = None) -> int:
         "--slo", action="store_true",
         help="INSTEAD of predicting: fetch the gateway's /debug/slo (its "
         "client-observed view merged with every model-tier replica's) and "
-        "render per-model goodput + 5m/1h burn rates",
+        "render per-model goodput + 5m/1h burn rates, plus the per-token "
+        "decode view (TTFT/TPOT percentiles) for replicas running the "
+        "generative lane",
+    )
+    p.add_argument(
+        "--stream", default=None, metavar="PROMPT",
+        help="INSTEAD of predicting: stream a generation for PROMPT from "
+        "the gateway's /generate route, printing each token as it "
+        "arrives plus the server-measured TTFT/TPOT from the done "
+        "event; --model routes to a non-default decode model",
+    )
+    p.add_argument(
+        "--max-new-tokens", type=int, default=16,
+        help="generation length cap for --stream (server also stops at EOS "
+        "or the propagated deadline)",
     )
     args = p.parse_args(argv)
     if args.slo:
-        print(render_slo(fetch_slo(args.gateway)))
+        payload = fetch_slo(args.gateway)
+        print(render_slo(payload))
+        print(render_decode_slo(payload))
         return 0
+    if args.stream is not None:
+        stats = {}
+        done = None
+        for ev in generate_stream(
+            args.gateway, args.stream,
+            max_new_tokens=args.max_new_tokens, model=args.model,
+            deadline_ms=args.deadline_ms, priority=args.priority,
+            stats=stats,
+        ):
+            if ev.get("done"):
+                done = ev
+                continue
+            sys.stdout.write(ev.get("text", ""))
+            sys.stdout.flush()
+        print()
+        if done is None:
+            print("# stream ended without a done event (connection lost "
+                  "mid-generation)", file=sys.stderr)
+            return 1
+        print(
+            f"# {done.get('tokens', 0)} tokens, "
+            f"ttft {done.get('ttft_ms', 0):.1f} ms, "
+            f"tpot {done.get('tpot_ms') if done.get('tpot_ms') is not None else float('nan'):.2f} ms, "
+            f"finish={done.get('finish_reason', '?')}, "
+            f"request_id={stats.get('request_id') or '-'}",
+            file=sys.stderr,
+        )
+        if args.stats:
+            # The fleet's per-token SLO posture right after this stream:
+            # where the generation's TTFT/TPOT sit against the window.
+            try:
+                print(render_decode_slo(fetch_slo(args.gateway)),
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 - diagnostics only
+                print(f"# decode slo fetch failed: {e}", file=sys.stderr)
+        return 0 if done.get("finish_reason") != "deadline" else 1
     stats: dict = {}
     scores = predict_url(
         args.gateway, args.image_url,

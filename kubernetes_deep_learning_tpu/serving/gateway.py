@@ -89,6 +89,18 @@ BROWNOUT_EVAL_S = 1.0
 # Model names are path/label material: constrain them before they touch
 # URLs, metrics labels, or upstream requests.
 _MODEL_NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
+# Generative lane routing: ``POST /generate`` streams tokens from the
+# decode model (``/generate/<model>`` routes explicitly).  The default
+# model name mirrors the model tier's lane ($KDLT_DECODE_MODEL); the
+# gateway holds only the NAME -- decode weights and the KV-cache live in
+# the model tier, this tier proxies the event stream.
+DECODE_MODEL_ENV = "KDLT_DECODE_MODEL"
+DEFAULT_DECODE_MODEL = "gen-default"
+# A token stream outlives any single-response deadline: connect fast,
+# then read with a generous per-chunk idle timeout (each TOKEN resets
+# it -- this bounds decode silence, not stream length).
+GENERATE_CONNECT_TIMEOUT_S = 5.0
+GENERATE_IDLE_TIMEOUT_S = 60.0
 PREDICT_TIMEOUT_S = 20.0     # reference's gRPC deadline (model_server.py:55)
 PER_IMAGE_TIMEOUT_S = 0.25   # extra upstream budget per batched image: a
                              # 256-image predict is one POST and must not be
@@ -177,6 +189,14 @@ class Gateway:
             SERVING_HOST_ENV, DEFAULT_SERVING_HOST
         )
         self.model = model or os.environ.get(MODEL_ENV, DEFAULT_MODEL)
+        # The generative lane's default route target: /generate goes to
+        # this model on the model tier's :generate route.  Purely a name
+        # here -- the gateway never loads decode weights; it proxies the
+        # token stream.
+        self.decode_model = (
+            os.environ.get(DECODE_MODEL_ENV, "").strip()
+            or DEFAULT_DECODE_MODEL
+        )
         self._session_obj = None
         self._session_lock = threading.Lock()
         self._spec_lock = threading.Lock()
@@ -1454,7 +1474,7 @@ class Gateway:
             self._singleflight.finish(key, flight)
             flight.fail(e)
             raise
-        if not salt and self.cache.storable_status(status):
+        if not salt and self.cache.storable_response(status, ctype):
             # Store BEFORE detaching the flight: an arrival in between
             # hits the cache instead of starting a duplicate flight.
             # Salted requests are deliberate cache opt-outs: they
@@ -1463,9 +1483,11 @@ class Gateway:
             # learned the model's artifact hash / contract (the first
             # request of a model, or the first after a reload), and the
             # entry must live under the key every future lookup computes.
-            # storable_status: 200 always; 404/400 only under the short
+            # storable_response: 200 always; 404/400 only under the short
             # negative TTL (a hammered bad URL stops paying the fetch
-            # path); 5xx never -- upstream failures are not replayable.
+            # path); 5xx never -- upstream failures are not replayable;
+            # text/event-stream never -- a token stream is a live
+            # connection, not a replayable value.
             self.cache.put(
                 self._cache_key(routed, str(req.get("url", "")), salt),
                 out, ctype, routed, self.cache.resolved_hash(routed),
@@ -1688,6 +1710,230 @@ class Gateway:
                     span_id=rt.span_id, urls=n_urls,
                 )
 
+    def handle_generate(
+        self,
+        body: bytes,
+        request_id: str | None = None,
+        deadline: Deadline | None = None,
+        model: str | None = None,
+        priority: str | None = None,
+    ):
+        """POST /generate -> (status, payload, content_type, extra_headers).
+
+        ``payload`` is complete bytes for every error response; for a 200
+        event-stream it is an ITERATOR of raw chunk bytes proxied from the
+        model tier as they arrive (both transports write it chunked, one
+        flush per chunk, so tokens reach the client at decode speed).
+
+        Deliberately NOT on the cache/singleflight/hedging path: a token
+        stream is a stateful live connection.  Caching one replays a dead
+        transcript (the cache's store predicate refuses the content type
+        as a backstop), coalescing would fan one client's generation out
+        to strangers, and a hedge would run the SAME generation twice on
+        two replicas -- paying double decode for a stream you can only
+        deliver once.  Failover is therefore connect-time only: once the
+        stream starts, a mid-stream replica death truncates (the client
+        sees a missing done event and retries).
+
+        Brownout and admission still apply, ahead of any upstream work:
+        the admission ticket is held for the LIFE of the stream, so an
+        active generation occupies gateway concurrency exactly like an
+        in-flight predict.  SLO accounting happens at stream end -- the
+        done event's finish_reason (the model tier already judged the
+        per-token TTFT/TPOT budgets there) plus stream truncation decide
+        deadline_exceeded, so a decode-lane burn drives this tier's
+        brownout ladder like any other burn.
+        """
+        import requests
+
+        t0 = time.perf_counter()
+        rid = request_id or ensure_request_id(None)
+        routed = model or self.decode_model
+        priority = protocol.parse_priority(priority)
+        rt = self.tracer.request_trace(rid)
+        w_start = trace_lib.now_s()
+        self._m_requests.inc()
+        metrics_lib.model_request_counter(self.registry, routed).inc()
+
+        def account(status: int, *, deadline_exceeded: bool = False) -> None:
+            dt = time.perf_counter() - t0
+            self._m_latency.observe(
+                dt,
+                exemplar=rid if metrics_lib.exemplars_enabled() else None,
+            )
+            late = deadline_exceeded or (
+                deadline is not None and deadline.expired
+            )
+            self.slo.record(routed, status, dt, deadline_exceeded=late)
+            self.tracer.record(
+                rid, trace_lib.SPAN_GATEWAY_GENERATE, w_start,
+                trace_lib.now_s() - w_start,
+                span_id=rt.span_id, status=status,
+            )
+            self.tracer.classify(
+                rid, trace_lib.retention_class(status, late, False)
+            )
+            if self.request_log or (
+                status >= 500 and status not in (503, 504)
+            ):
+                log_request(
+                    "gateway generate", rid, status=status, t0=t0,
+                    span_id=rt.span_id,
+                )
+
+        def error(status: int, msg: str, extra: dict | None = None):
+            self._m_errors.inc()
+            account(status)
+            return status, json.dumps(
+                {"error": msg}
+            ).encode(), "application/json", dict(extra or {})
+
+        if self.brownout.sheds(priority):
+            # Same class shed as /predict, ahead of admission AND any
+            # upstream connection: a shed best-effort generation costs
+            # zero decode slots anywhere.
+            self.admission.count_shed("brownout", priority)
+            self.recorder.note_shed()
+            e = self._brownout_shed(priority)
+            self._m_errors.inc()
+            account(e.http_status)
+            return e.http_status, json.dumps(
+                {"error": str(e), "shed_reason": e.reason}
+            ).encode(), "application/json", e.headers()
+        if deadline is None and self.admission.enabled:
+            deadline = Deadline.default()
+        ticket = None
+        try:
+            with rt.span(trace_lib.SPAN_GATEWAY_ADMISSION):
+                ticket = self.admission.admit(
+                    deadline, model=routed,
+                    priority=priority or protocol.DEFAULT_PRIORITY,
+                )
+        except Shed as e:
+            self.recorder.note_shed()
+            self._m_errors.inc()
+            account(e.http_status)
+            return e.http_status, json.dumps(
+                {"error": str(e), "shed_reason": e.reason}
+            ).encode(), "application/json", e.headers()
+
+        headers = {"Content-Type": protocol.JSON_CONTENT_TYPE}
+        headers[REQUEST_ID_HEADER] = rid
+        if deadline is not None:
+            headers[DEADLINE_HEADER] = deadline.header_value()
+        if priority:
+            headers[PRIORITY_HEADER] = priority
+        read_timeout = GENERATE_IDLE_TIMEOUT_S
+        if deadline is not None:
+            read_timeout = deadline.clamp(read_timeout)
+        tried: list = []
+        r = None
+        replica = None
+        last_err: Exception | None = None
+        # Connect-time failover only: up to two replicas, first stream
+        # wins.  Each pool.choose consumed a breaker allow(), so every
+        # pick is settled with record_success/record_failure.
+        for _ in range(2):
+            replica = self.pool.choose(exclude=tried)
+            if replica is None:
+                break
+            tried.append(replica)
+            sid = trace_lib.new_span_id()
+            headers[PARENT_SPAN_HEADER] = sid
+            w0 = trace_lib.now_s()
+            try:
+                r = self._session().post(
+                    f"{replica.base}/v1/models/{routed}:generate",
+                    data=body, headers=headers,
+                    timeout=(GENERATE_CONNECT_TIMEOUT_S, read_timeout),
+                    stream=True,
+                )
+            except requests.RequestException as e:
+                self.pool.record_failure(replica)
+                self.tracer.record(
+                    rid, trace_lib.SPAN_GATEWAY_UPSTREAM, w0,
+                    trace_lib.now_s() - w0, parent_id=rt.span_id,
+                    span_id=sid, replica=replica.host, role="generate",
+                    error=str(e)[:120],
+                )
+                last_err = e
+                r = None
+                continue
+            # Headers arrived: the replica is alive and answered (even a
+            # 4xx/503 is an answer; breaker accounting is about reachability).
+            self.pool.record_success(replica, trace_lib.now_s() - w0)
+            self.tracer.record(
+                rid, trace_lib.SPAN_GATEWAY_UPSTREAM, w0,
+                trace_lib.now_s() - w0, parent_id=rt.span_id, span_id=sid,
+                replica=replica.host, role="generate", status=r.status_code,
+            )
+            break
+        if r is None:
+            ticket.release()
+            return error(
+                502,
+                f"no upstream replica reachable for generate: {last_err}",
+                retry_after_headers(self.pool.min_retry_after_s()),
+            )
+        ctype = r.headers.get("Content-Type", "application/json")
+        if r.status_code != 200 or not ctype.startswith(
+            protocol.EVENT_STREAM_CONTENT_TYPE
+        ):
+            # Complete (non-streamed) answer: JSON mode, or any error --
+            # pass the upstream's status and body through verbatim.
+            out = r.content
+            r.close()
+            if r.status_code == 503:
+                # AIMD congestion signal before release: the tier below
+                # is saturated, so this tier's concurrency limit is high.
+                ticket.mark_overloaded()
+                extra = retry_after_headers(self.admission.retry_after_s())
+            else:
+                extra = {}
+            ticket.release()
+            if r.status_code >= 400:
+                self._m_errors.inc()
+            account(r.status_code)
+            return r.status_code, out, ctype, extra
+
+        def stream():
+            """Pass-through chunk relay.  A small rolling tail keeps the
+            terminal done event parseable without buffering the stream;
+            the finally releases the admission ticket and closes the SLO
+            loop whether the stream completed, truncated, or the CLIENT
+            disconnected (GeneratorExit from the transport closes the
+            upstream response, which cancels the generation server-side)."""
+            tail = b""
+            truncated = True
+            try:
+                for chunk in r.iter_content(chunk_size=None):
+                    if not chunk:
+                        continue
+                    tail = (tail + chunk)[-4096:]
+                    yield chunk
+                truncated = False
+            except requests.RequestException:
+                pass  # upstream died mid-stream; the client sees truncation
+            finally:
+                r.close()
+                ticket.release()
+                done = None
+                for ev in protocol.parse_sse_events(tail):
+                    if ev.get("done"):
+                        done = ev
+                late = (
+                    truncated
+                    or done is None
+                    or done.get("finish_reason") == "deadline"
+                )
+                if truncated:
+                    self._m_errors.inc()
+                account(200, deadline_exceeded=late)
+
+        return 200, stream(), protocol.EVENT_STREAM_CONTENT_TYPE, {
+            "Cache-Control": "no-store"
+        }
+
     # --- HTTP plumbing ----------------------------------------------------
 
     def _make_handler(self):
@@ -1720,12 +1966,79 @@ class Gateway:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_stream(self, chunks, ctype: str, rid: str = "",
+                             extra: dict[str, str] | None = None):
+                """Write an iterator of chunk bytes as one HTTP/1.1
+                chunked-transfer response, flushing per chunk (tokens must
+                reach the client as they decode).  On client disconnect
+                the iterator is closed, which propagates cancellation all
+                the way to the decode slot."""
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                if rid:
+                    self.send_header(REQUEST_ID_HEADER, rid)
+                for k, v in (extra or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(
+                            f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except OSError:
+                    self.close_connection = True
+                finally:
+                    closer = getattr(chunks, "close", None)
+                    if closer is not None:
+                        closer()
+
             def do_GET(self):
                 self._send(*gw.handle_get(self.path))
+
+            def _generate(self, path: str, rid: str):
+                """POST /generate[/<model>]: proxy one token stream."""
+                model = None
+                if path.startswith("/generate/"):
+                    model = path[len("/generate/"):]
+                    if not _MODEL_NAME_RE.match(model):
+                        return self._send(
+                            404, b'{"error": "malformed model name"}',
+                            "application/json", rid,
+                        )
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                rejected = gw.reject_oversize(length)
+                if rejected is not None:
+                    self.close_connection = True
+                    return self._send(*rejected, rid)
+                deadline = (
+                    Deadline.from_header(self.headers.get(DEADLINE_HEADER))
+                    if gw.admission.enabled
+                    else None
+                )
+                status, payload, ctype, extra = gw.handle_generate(
+                    self.rfile.read(length), rid, deadline, model=model,
+                    priority=self.headers.get(PRIORITY_HEADER),
+                )
+                if status == 200 and not isinstance(
+                    payload, (bytes, bytearray)
+                ):
+                    return self._send_stream(payload, ctype, rid, extra)
+                summary = gw.tracer.summary(rid)
+                if summary:
+                    extra = {**extra, TRACE_HEADER: summary}
+                self._send(status, payload, ctype, rid, extra)
 
             def do_POST(self):
                 rid = ensure_request_id(self.headers.get(REQUEST_ID_HEADER))
                 path = self.path.split("?", 1)[0]
+                if path == "/generate" or path.startswith("/generate/"):
+                    return self._generate(path, rid)
                 if path != "/predict" and not path.startswith("/predict/"):
                     return self._send(
                         404, b'{"error": "not found"}', "application/json", rid

@@ -82,6 +82,7 @@ from kubernetes_deep_learning_tpu.utils import slo as slo_lib
 from kubernetes_deep_learning_tpu.utils import trace as trace_lib
 
 _PREDICT_RE = re.compile(r"^/v1/models/([^/:]+):predict$")
+_GENERATE_RE = re.compile(r"^/v1/models/([^/:]+):generate$")
 _STATUS_RE = re.compile(r"^/v1/models/([^/:]+):status$")
 _MODEL_RE = re.compile(r"^/v1/models/([^/:]+)$")
 
@@ -370,6 +371,8 @@ class ModelServer:
         incident_dir: str | None = None,
         incident_triggers: str | None = None,
         incident_dedup_s: float | None = None,
+        decode: bool | None = None,
+        decode_continuous: bool = True,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -493,6 +496,24 @@ class ModelServer:
         self.model_registry = ModelRegistry(
             model_root, loader=self._load_model, unloader=self._unload_model
         )
+        # Generative serving lane (serving.generate): the :generate route's
+        # decode subsystem -- continuous batching over a block-paged
+        # KV-cache with streamed SSE token responses.  Opt-in (--decode /
+        # $KDLT_DECODE=1): the image path's behavior is byte-identical with
+        # the lane off.  Shares this tier's registry, SLO engine, tracer,
+        # and flight recorder, so decode burn and image burn read off the
+        # same dashboards.
+        from kubernetes_deep_learning_tpu.serving import generate as generate_lib
+
+        self.generate: generate_lib.GenerateLane | None = None
+        if generate_lib.decode_enabled(decode):
+            self.generate = generate_lib.GenerateLane(
+                registry=self.registry, slo=self.slo, tracer=self.tracer,
+                recorder=self.recorder, continuous=decode_continuous,
+            )
+            self.recorder.add_snapshot_provider(
+                "decode", self.generate.debug_payload
+            )
         self._watcher: threading.Thread | None = None
         self._watcher_stop = threading.Event()
         self._profile_lock = threading.Lock()
@@ -508,6 +529,14 @@ class ModelServer:
         for m in self.models.values():
             dt = m.engine.warmup()
             print(f"warmed {m.artifact.spec.name}: {dt:.1f}s", file=sys.stderr)
+        if self.generate is not None:
+            rep = self.generate.warmup()
+            total = sum(rep["buckets"].values()) + rep["step_s"]
+            print(
+                f"warmed decode {rep['model']}: {total:.1f}s "
+                f"(prefill buckets {sorted(rep['buckets'])}, one step)",
+                file=sys.stderr,
+            )
 
     @property
     def ready(self) -> bool:
@@ -679,6 +708,49 @@ class ModelServer:
             def _send_json(self, code: int, obj, headers=None):
                 self._send(code, json.dumps(obj).encode(), headers=headers)
 
+            def _send_stream(
+                self, code: int, chunks, ctype: str,
+                headers: dict[str, str] | None = None,
+            ) -> bool:
+                """Stream an iterator of byte chunks as one chunked-transfer
+                response (the SSE token path).  _send always sets
+                Content-Length, which a live stream cannot know; here the
+                HTTP/1.1 chunked framing is written by hand -- hex size,
+                CRLF, payload, CRLF, with a zero-length terminator -- and
+                every chunk is flushed so tokens reach the client as they
+                decode, not when the generation ends.  Returns False if the
+                client went away mid-stream (the caller closes the
+                iterator, which cancels the generation)."""
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                if getattr(self, "_rid", ""):
+                    self.send_header(REQUEST_ID_HEADER, self._rid)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                try:
+                    for chunk in chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(
+                            f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                    return True
+                except OSError:
+                    # Client disconnect mid-stream: stop the generation
+                    # (the iterator's close -> GeneratorExit -> cancel) and
+                    # retire the connection.
+                    self.close_connection = True
+                    return False
+                finally:
+                    closer = getattr(chunks, "close", None)
+                    if closer is not None:
+                        closer()
+
             # Bodies at most this size are drained (not closed over) when a
             # response goes out before the body was read: sheds happen
             # under overload, exactly when the gateway's pooled keep-alive
@@ -747,7 +819,14 @@ class ModelServer:
                     server.slo.refresh()
                     return self._send(200, server.registry.render().encode(), "text/plain")
                 if self.path == "/debug/slo":
-                    return self._send_json(200, server.slo.debug_payload())
+                    payload = server.slo.debug_payload()
+                    if server.generate is not None:
+                        # Per-token view alongside the per-request windows:
+                        # TTFT/TPOT percentiles, budgets, occupancy --
+                        # what kdlt-client --stats renders as its decode
+                        # columns.
+                        payload["decode"] = server.generate.debug_payload()
+                    return self._send_json(200, payload)
                 if self.path in ("/debug", "/debug/"):
                     # The debug INDEX: every debug surface this tier
                     # serves, one line each (operators should not have to
@@ -830,6 +909,9 @@ class ModelServer:
                 batch = 0
                 self._body_consumed = False
                 server._m_requests.inc()
+                g = _GENERATE_RE.match(self.path)
+                if g is not None:
+                    return self._generate(g.group(1), rid, parent, rt, w_start, t0)
                 m = _PREDICT_RE.match(self.path)
                 if not m:
                     server._m_errors.inc()
@@ -1072,6 +1154,142 @@ class ModelServer:
                             batch=batch,
                         )
 
+            def _generate(self, name, rid, parent, rt, w_start, t0):
+                """POST /v1/models/<name>:generate -- the generative lane.
+
+                Same front door as :predict (admission before the body is
+                read, priority-aware shed, deadline propagation), different
+                back half: a 200 with ``stream`` is a chunked
+                text/event-stream of per-token SSE frames, written as the
+                decode loop emits them.  The lane does its own SLO
+                accounting at generation end (per-token budgets decide
+                deadline_exceeded), so this handler records SLO only for
+                requests the lane never saw (sheds, internal errors).
+                """
+                from kubernetes_deep_learning_tpu.serving import (
+                    generate as generate_lib,
+                )
+                from kubernetes_deep_learning_tpu.serving import protocol
+
+                lane = server.generate
+                status = 500
+                if lane is None:
+                    server._m_errors.inc()
+                    self._discard_body()
+                    return self._send_json(
+                        404,
+                        {"error": "generative lane disabled (start the "
+                         "server with --decode or KDLT_DECODE=1)"},
+                    )
+                if name != lane.model:
+                    server._m_errors.inc()
+                    self._discard_body()
+                    return self._send_json(
+                        404, {"error": f"no generative model {name!r}"}
+                    )
+                metrics_lib.model_request_counter(
+                    server.registry, name
+                ).inc()
+                deadline = (
+                    Deadline.from_header(self.headers.get(DEADLINE_HEADER))
+                    if server.admission.enabled
+                    else None
+                )
+                priority = protocol.parse_priority(
+                    self.headers.get(protocol.PRIORITY_HEADER)
+                )
+                ticket = None
+                lane_recorded = False
+                try:
+                    with rt.span(trace_lib.SPAN_SERVER_ADMISSION):
+                        ticket = server.admission.admit(
+                            deadline, model=name, priority=priority
+                        )
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    if length > generate_lib.MAX_GENERATE_BODY_BYTES:
+                        self.close_connection = True
+                        raise ValueError(
+                            f"generate body {length} bytes exceeds the "
+                            f"{generate_lib.MAX_GENERATE_BODY_BYTES}-byte limit"
+                        )
+                    with rt.span(trace_lib.SPAN_SERVER_DECODE, bytes=length):
+                        body = self.rfile.read(length)
+                        self._body_consumed = True
+                    status, payload, ctype, extra = lane.handle_generate(
+                        body, rid=rid, deadline=deadline, priority=priority
+                    )
+                    lane_recorded = True  # the lane owns SLO from here on
+                    if status != 200:
+                        server._m_errors.inc()
+                    if (
+                        status == 200
+                        and ctype == protocol.EVENT_STREAM_CONTENT_TYPE
+                    ):
+                        # The admission ticket is held for the STREAM's
+                        # lifetime (released in the finally): an active
+                        # generation is exactly the concurrency the
+                        # limiter should be counting.
+                        self._send_stream(200, payload, ctype, headers=extra)
+                    else:
+                        self._send(status, payload, ctype, headers=extra or None)
+                except Shed as e:  # admission refusal, not a fault
+                    server._m_errors.inc()
+                    status = e.http_status
+                    self._discard_body()
+                    self._send_json(
+                        status,
+                        {"error": str(e), "shed_reason": e.reason},
+                        headers=e.headers(),
+                    )
+                except ValueError as e:  # malformed request
+                    server._m_errors.inc()
+                    status = 400
+                    self._send_json(400, {"error": str(e)})
+                except Exception as e:  # internal failure
+                    server._m_errors.inc()
+                    status = 500
+                    self._send_json(500, {"error": str(e)})
+                finally:
+                    self._discard_body()
+                    if ticket is not None:
+                        ticket.release()
+                    dt = time.perf_counter() - t0
+                    server._m_latency.observe(
+                        dt,
+                        exemplar=(
+                            rid if metrics_lib.exemplars_enabled() else None
+                        ),
+                    )
+                    if not lane_recorded:
+                        server.slo.record(
+                            lane.model, status, dt, deadline_exceeded=False
+                        )
+                    deadline_exceeded = (
+                        deadline is not None and deadline.expired
+                    )
+                    server.tracer.record(
+                        rid, trace_lib.SPAN_SERVER_GENERATE, w_start,
+                        trace_lib.now_s() - w_start,
+                        parent_id=parent, span_id=rt.span_id, status=status,
+                    )
+                    server.tracer.classify(
+                        rid,
+                        trace_lib.retention_class(
+                            status, deadline_exceeded, False
+                        ),
+                    )
+                    if server.request_log or (
+                        status >= 500 and status not in (503, 504)
+                    ):
+                        log_request(
+                            "model-server generate",
+                            rid,
+                            status=status,
+                            t0=t0,
+                            span_id=rt.span_id,
+                            model=name,
+                        )
+
             def _profile(self):
                 """Capture a jax.profiler trace while live traffic runs.
 
@@ -1158,7 +1376,8 @@ class ModelServer:
             "tier": "model-server",
             "routes": {
                 "/debug/slo": "per-model goodput and burn-rate windows "
-                "as this replica observed them",
+                "as this replica observed them (plus the decode lane's "
+                "per-token TTFT/TPOT view when --decode is on)",
                 "/debug/incidents": "flight-recorder bundles captured on "
                 "this replica",
                 "/debug/incidents/<id>": "one full incident bundle "
@@ -1208,6 +1427,8 @@ class ModelServer:
 
     def shutdown(self) -> None:
         self._watcher_stop.set()
+        if self.generate is not None:
+            self.generate.close()
         self.recorder.close()
         if self._watcher is not None:
             self._watcher.join(timeout=5)
@@ -1484,6 +1705,22 @@ def main(argv: list[str] | None = None) -> int:
         "deployment mounts a cache volume for exactly this)",
     )
     p.add_argument(
+        "--decode",
+        action="store_true",
+        help="ALSO serve the generative lane (/v1/models/<m>:generate): "
+        "continuous-batching autoregressive decode over a block-paged "
+        "KV-cache with streamed text/event-stream token responses and "
+        "per-token TTFT/TPOT SLOs.  Default $KDLT_DECODE=1; the model "
+        "name is $KDLT_DECODE_MODEL (gen-default)",
+    )
+    p.add_argument(
+        "--static-decode-batching",
+        action="store_true",
+        help="with --decode: replace continuous (token-boundary) batching "
+        "with static request-boundary batching -- the A/B baseline the "
+        "bench's --decode-ab compares against; never use in production",
+    )
+    p.add_argument(
         "--aot-warm",
         action="store_true",
         help="AOT-compile every model's FULL default bucket ladder into "
@@ -1576,6 +1813,8 @@ def main(argv: list[str] | None = None) -> int:
             else resolve_weights(args.sched_weights)
         ),
         slo=False if args.no_slo else None,
+        decode=True if args.decode else None,
+        decode_continuous=not args.static_decode_batching,
     )
     # SIGTERM -> flip /readyz, stop admission, let in-flight batches finish,
     # then stop; fits inside the k8s terminationGracePeriodSeconds budget.

@@ -25,6 +25,15 @@ import numpy as np
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
 JSON_CONTENT_TYPE = "application/json"
 
+# The generative lane's streamed response body: Server-Sent Events over
+# HTTP/1.1 chunked transfer.  Every streamed token is one ``data:`` event;
+# the terminal event carries ``"done": true`` plus the per-token SLO
+# numbers (TTFT/TPOT) so clients never have to clock the stream
+# themselves.  A response with this content type is a live connection,
+# not a value: the response cache and singleflight refuse it by predicate
+# (serving.cache.storable_response).
+EVENT_STREAM_CONTENT_TYPE = "text/event-stream"
+
 # Multi-model routing header: names the served model a /predict request
 # targets when the URL path carries no model segment (the gateway's
 # /predict/<model> form wins when both are present).  Lives here -- the
@@ -140,3 +149,84 @@ def decode_predict_response(body: bytes, content_type: str) -> tuple[np.ndarray,
     preds = msg["predictions"]
     labels = list(preds[0].keys())
     return np.asarray([[p[l] for l in labels] for p in preds], np.float32), labels
+
+
+# --- generative lane --------------------------------------------------------
+# JSON request, SSE response.  The request schema is deliberately tiny:
+# prompts are text (byte-level tokenization happens in the decode engine,
+# so there is no tokenizer contract on the wire), and every knob has a
+# server-side cap.
+
+GENERATE_MAX_NEW_TOKENS_CAP = 1024
+
+
+def decode_generate_request(body: bytes) -> dict[str, Any]:
+    """Parse and validate a /generate JSON body.
+
+    Returns ``{"prompt": str, "max_new_tokens": int, "stream": bool}``.
+    Raises ValueError on anything malformed -- the transports map that to
+    a 400, same as a bad /predict body.
+    """
+    try:
+        msg = json.loads(body)
+    except Exception as e:  # noqa: BLE001 - mapped to 400 by the caller
+        raise ValueError(f"invalid JSON body: {e}") from e
+    if not isinstance(msg, dict) or "prompt" not in msg:
+        raise ValueError('generate body must be a JSON object with "prompt"')
+    prompt = msg["prompt"]
+    if not isinstance(prompt, str) or not prompt:
+        raise ValueError('"prompt" must be a non-empty string')
+    raw_n = msg.get("max_new_tokens", 16)
+    try:
+        n = int(raw_n)
+    except (TypeError, ValueError) as e:
+        raise ValueError('"max_new_tokens" must be an integer') from e
+    if n < 1 or n > GENERATE_MAX_NEW_TOKENS_CAP:
+        raise ValueError(
+            f'"max_new_tokens" must be in [1, {GENERATE_MAX_NEW_TOKENS_CAP}]'
+        )
+    return {
+        "prompt": prompt,
+        "max_new_tokens": n,
+        "stream": bool(msg.get("stream", True)),
+    }
+
+
+def sse_event(payload: dict[str, Any]) -> bytes:
+    """One Server-Sent Events frame: ``data: <json>\\n\\n``."""
+    return b"data: " + json.dumps(payload, separators=(",", ":")).encode() + b"\n\n"
+
+
+def sse_token_event(index: int, token: int, text: str) -> bytes:
+    """A per-token event: position, token id, and its decoded text."""
+    return sse_event({"index": index, "token": token, "text": text})
+
+
+def sse_done_event(
+    *, tokens: int, ttft_ms: float, tpot_ms: float, finish_reason: str,
+    text: str,
+) -> bytes:
+    """The terminal event: totals plus the per-token SLO observations."""
+    return sse_event({
+        "done": True,
+        "tokens": tokens,
+        "ttft_ms": round(ttft_ms, 3),
+        "tpot_ms": round(tpot_ms, 3),
+        "finish_reason": finish_reason,
+        "text": text,
+    })
+
+
+def parse_sse_events(raw: bytes) -> list[dict[str, Any]]:
+    """Split a complete SSE body back into its JSON payloads (client and
+    test-side helper; tolerant of a trailing partial frame)."""
+    events: list[dict[str, Any]] = []
+    for frame in raw.split(b"\n\n"):
+        frame = frame.strip()
+        if not frame.startswith(b"data:"):
+            continue
+        try:
+            events.append(json.loads(frame[len(b"data:"):].strip()))
+        except Exception:  # noqa: BLE001 - partial tail frame
+            continue
+    return events

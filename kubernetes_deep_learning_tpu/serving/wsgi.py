@@ -60,6 +60,59 @@ class GatewayWSGI:
         if method == "GET":
             code, body, ctype = self.gateway.handle_get(path)
         elif method == "POST" and (
+            path == "/generate" or path.startswith("/generate/")
+        ):
+            # Generative lane: a 200 streamed payload is an ITERATOR of
+            # SSE chunk bytes -- returned directly as the WSGI iterable
+            # (no Content-Length, so the server chunk-streams it; gunicorn
+            # flushes per yielded chunk, which is what token streaming
+            # needs).  Everything else is a complete body below.
+            from kubernetes_deep_learning_tpu.serving.gateway import (
+                _MODEL_NAME_RE,
+            )
+
+            model = None
+            seg = path[len("/generate/"):] if path.startswith("/generate/") else ""
+            if seg:
+                if not _MODEL_NAME_RE.match(seg):
+                    code, body, ctype = (
+                        404, b'{"error": "malformed model name"}',
+                        "application/json",
+                    )
+                    start_response(
+                        _status_line(code),
+                        [("Content-Type", ctype),
+                         ("Content-Length", str(len(body))),
+                         (REQUEST_ID_HEADER, rid)],
+                    )
+                    return [body]
+                model = seg
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+            rejected = self.gateway.reject_oversize(length)
+            if rejected is not None:
+                code, body, ctype = rejected
+            else:
+                deadline = (
+                    Deadline.from_header(environ.get(WSGI_DEADLINE_KEY))
+                    if self.gateway.admission.enabled
+                    else None
+                )
+                code, payload, ctype, extra = self.gateway.handle_generate(
+                    environ["wsgi.input"].read(length), rid, deadline,
+                    model=model, priority=environ.get(WSGI_PRIORITY_KEY),
+                )
+                if code == 200 and not isinstance(
+                    payload, (bytes, bytearray)
+                ):
+                    start_response(
+                        _status_line(200),
+                        [("Content-Type", ctype),
+                         (REQUEST_ID_HEADER, rid),
+                         *extra.items()],
+                    )
+                    return payload
+                body = payload
+        elif method == "POST" and (
             path == "/predict" or path.startswith("/predict/")
         ):
             # Same model routing as the threaded transport: path segment

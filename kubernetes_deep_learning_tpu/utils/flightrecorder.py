@@ -85,6 +85,10 @@ EVENT_KINDS = frozenset({
     "quant.gate_fail",    # int8 warmup tolerance gate refused activations
     "warm.compile",       # warmup bucket missed the compile cache
     "incident.capture",   # the recorder itself captured a bundle
+    "decode.saturated",   # every decode slot busy while the admission
+                          # queue is non-empty (attrs: queued, slots)
+    "decode.shed",        # a generation was refused/retired by policy --
+                          # queue full or deadline (attrs: reason)
 })
 
 # Trigger rules: what fires each one, what clears (re-arms) it, and the

@@ -75,6 +75,16 @@ SPAN_PIPELINE_READBACK = "pipeline.readback"
 SPAN_CROSSHOST_BROADCAST = "crosshost.broadcast"
 SPAN_CROSSHOST_COLLECTIVE = "crosshost.collective"
 SPAN_CROSSHOST_GATHER = "crosshost.gather"
+# Generative (decode) lane: the gateway proxy span, the model tier's
+# handler span, and the decode engine's internal stages.  first_token
+# covers admission-to-first-emission (the TTFT interval as the server saw
+# it); stream covers the remainder of the token loop.
+SPAN_GATEWAY_GENERATE = "gateway.generate"
+SPAN_SERVER_GENERATE = "server.generate"
+SPAN_DECODE_QUEUE_WAIT = "decode.queue_wait"
+SPAN_DECODE_PREFILL = "decode.prefill"
+SPAN_DECODE_FIRST_TOKEN = "decode.first_token"
+SPAN_DECODE_STREAM = "decode.stream"
 
 SPAN_NAMES = frozenset({
     SPAN_GATEWAY_REQUEST,
@@ -97,6 +107,12 @@ SPAN_NAMES = frozenset({
     SPAN_CROSSHOST_BROADCAST,
     SPAN_CROSSHOST_COLLECTIVE,
     SPAN_CROSSHOST_GATHER,
+    SPAN_GATEWAY_GENERATE,
+    SPAN_SERVER_GENERATE,
+    SPAN_DECODE_QUEUE_WAIT,
+    SPAN_DECODE_PREFILL,
+    SPAN_DECODE_FIRST_TOKEN,
+    SPAN_DECODE_STREAM,
 })
 
 # One wall-anchored monotonic clock per process: perf_counter deltas on a

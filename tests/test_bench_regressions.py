@@ -136,6 +136,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--obs-overhead-ab", "5"], "obs_overhead_ab"),
         (["--tenant-ab", "5"], "tenant_ab"),
         (["--incident-ab", "6"], "incident_ab"),
+        (["--decode-ab", "16"], "decode_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -282,6 +283,29 @@ def test_dry_run_mesh_ab_echoes_the_mesh_config():
     assert out["mesh"]["floor_frac"] == 0.1
 
 
+def test_dry_run_decode_ab_echoes_the_decode_config():
+    # The --decode-ab invocation surface (the generative lane's
+    # continuous-batching acceptance gate, GUIDE 10p) is pinned here
+    # without importing jax or compiling the decode ladder.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--decode-ab", "12", "--dry-run",
+         "--decode-slots", "2", "--decode-step-ms", "5",
+         "--decode-deadline-ms", "1500", "--decode-ttft-budget-ms", "800",
+         "--decode-seed", "7"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=60,
+    )
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "decode_ab"
+    assert out["decode"]["requests"] == 12
+    assert out["decode"]["slots"] == 2
+    assert out["decode"]["step_ms"] == 5.0
+    assert out["decode"]["deadline_ms"] == 1500.0
+    assert out["decode"]["ttft_budget_ms"] == 800.0
+    assert out["decode"]["seed"] == 7
+
+
 def test_dry_run_multimodel_ab_echoes_the_scheduler_config():
     # The --multimodel-ab invocation surface (the unified scheduler's
     # acceptance harness) must round-trip the CLI.
@@ -386,6 +410,31 @@ def test_multimodel_ab_weighted_beats_fifo_on_worst_model_goodput():
         w["models"]["mm-heavy"]["goodput_frac"]
         >= 0.8 * f["models"]["mm-heavy"]["goodput_frac"]
     )
+
+
+@pytest.mark.slow
+def test_decode_ab_continuous_wins_goodput_and_stays_bit_exact():
+    """ISSUE 17's acceptance bar (slow: compiles the decode ladder and
+    runs two timed arms): under a closed burst of mixed-length
+    generations with per-request deadlines, continuous (token-boundary)
+    admission beats static request-boundary batching on in-deadline
+    token goodput, holds TTFT p99 within the lane's budget, and every
+    sampled continuous-batch token stream is bit-identical to the same
+    prompt decoded solo on the same engine."""
+    bench = _bench_module()
+    out, rc = bench.bench_decode_ab(n_requests=12, step_ms=10.0,
+                                    deadline_ms=2000.0)
+    assert rc == 0, out
+    arms = out["arms"]
+    assert (
+        arms["continuous"]["tokens_in_deadline"]
+        >= arms["static"]["tokens_in_deadline"]
+    ), arms
+    assert arms["continuous"]["ttft_p99_ms"] <= out["ttft_budget_ms"], arms
+    assert out["bit_exact_vs_solo"] is True
+    # The convoy effect is the mechanism: static's TTFT p99 must reflect
+    # late waves queuing behind full batch drains.
+    assert arms["static"]["ttft_p99_ms"] > arms["continuous"]["ttft_p99_ms"], arms
 
 
 @pytest.mark.slow

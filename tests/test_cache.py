@@ -92,6 +92,28 @@ def test_response_cache_lru_eviction_respects_byte_budget():
     assert c.get("huge") is None
 
 
+def test_event_stream_responses_are_never_storable():
+    # ISSUE 17 regression: a text/event-stream body is a live token
+    # stream's transcript -- caching or singleflight-fanning one would
+    # replay client A's generation to client B as a dead recording.  The
+    # store predicate refuses the content type outright, for every
+    # otherwise-storable status, so no future route can wire a stream
+    # into the cache by accident.
+    c = cache_lib.ResponseCache(ttl_s=60.0, max_mb=1.0, neg_ttl_s=5.0)
+    assert c.storable_response(200, "application/json") is True
+    assert c.storable_response(200, "text/event-stream") is False
+    # Parameters and casing do not re-admit it.
+    assert c.storable_response(200, "TEXT/EVENT-STREAM; charset=utf-8") is False
+    assert c.storable_response(200, " text/event-stream ") is False
+    assert c.storable_response(404, "text/event-stream") is False
+    # No content type (legacy callers) falls back to the status rule.
+    assert c.storable_response(200, None) is True
+    # put() enforces the same predicate end to end.
+    assert c.put("s", b"data: {}\n\n", "text/event-stream", "m", "h") is False
+    assert c.get("s") is None
+    assert c.put("j", b"{}", "application/json", "m", "h") is True
+
+
 def test_response_cache_artifact_hash_invalidation_semantics():
     c = cache_lib.ResponseCache(ttl_s=60.0, max_mb=1.0)
     assert c.resolved_hash("m") == cache_lib.UNRESOLVED_HASH

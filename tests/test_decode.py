@@ -1,0 +1,230 @@
+"""The decode subsystem's device half (runtime/decode.py): paged
+KV-cache bookkeeping, the continuous-batching scheduler, and the lane's
+load-bearing invariant -- token streams from a shifting continuous batch
+are bit-identical to solo decode.
+
+The engine under test is the lane's real engine (tiny byte-level
+transformer, real jitted prefill/step programs on CPU), sized small
+(2 slots, 8-token pages) so the whole file compiles two prefill buckets
+plus one step program once, module-scoped.  Pure token/SSE plumbing
+tests run first and need no jax at all.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_deep_learning_tpu.runtime import decode as decode_lib
+from kubernetes_deep_learning_tpu.runtime.batcher import QueueFull
+from kubernetes_deep_learning_tpu.serving import protocol
+from kubernetes_deep_learning_tpu.serving.admission import Deadline
+
+
+# --- token + SSE plumbing (no device, no jax) --------------------------------
+
+
+def test_encode_decode_prompt_round_trip():
+    tokens = decode_lib.encode_prompt("hello, tpu!")
+    assert tokens[0] == decode_lib.BOS_TOKEN
+    assert decode_lib.decode_tokens(tokens[1:]) == "hello, tpu!"
+    # Specials never decode into text.
+    assert decode_lib.decode_tokens(
+        [decode_lib.BOS_TOKEN, 104, 105, decode_lib.EOS_TOKEN]
+    ) == "hi"
+
+
+def test_prompt_bucket_picks_smallest_fit_and_raises_on_overflow():
+    buckets = (16, 32, 64)
+    assert decode_lib.prompt_bucket(1, buckets) == 16
+    assert decode_lib.prompt_bucket(16, buckets) == 16
+    assert decode_lib.prompt_bucket(17, buckets) == 32
+    assert decode_lib.prompt_bucket(64, buckets) == 64
+    with pytest.raises(ValueError):
+        decode_lib.prompt_bucket(65, buckets)
+
+
+def test_generation_ttft_tpot_math():
+    gen = decode_lib.Generation(rid="r", prompt_tokens=[256], max_new_tokens=4)
+    assert gen.ttft_s() is None and gen.tpot_s() is None
+    gen.t_first = gen.t_submit + 0.5
+    gen.t_last = gen.t_first + 0.3
+    gen.tokens = [1, 2, 3, 4]
+    assert gen.ttft_s() == pytest.approx(0.5)
+    # TPOT is the inter-token mean EXCLUDING the first token (that one is
+    # TTFT's): 0.3s over 3 gaps.
+    assert gen.tpot_s() == pytest.approx(0.1)
+    # A single-token generation has no inter-token gap to average.
+    gen.tokens = [1]
+    assert gen.tpot_s() is None
+
+
+def test_sse_events_round_trip_through_the_parser():
+    frames = (
+        protocol.sse_token_event(0, 104, "h")
+        + protocol.sse_token_event(1, 105, "i")
+        + protocol.sse_done_event(
+            tokens=2, ttft_ms=1.5, tpot_ms=0.5,
+            finish_reason="length", text="hi",
+        )
+    )
+    events = protocol.parse_sse_events(frames)
+    assert [e.get("token") for e in events[:-1]] == [104, 105]
+    done = events[-1]
+    assert done["done"] is True
+    assert done["finish_reason"] == "length"
+    assert done["text"] == "hi"
+    assert done["tokens"] == 2
+
+
+def test_decode_generate_request_validation():
+    ok = protocol.decode_generate_request(b'{"prompt": "hi"}')
+    assert ok == {"prompt": "hi", "max_new_tokens": 16, "stream": True}
+    ok = protocol.decode_generate_request(
+        b'{"prompt": "hi", "max_new_tokens": 3, "stream": false}'
+    )
+    assert ok["max_new_tokens"] == 3 and ok["stream"] is False
+    for bad in (
+        b"notjson",
+        b'["prompt"]',
+        b'{"nope": 1}',
+        b'{"prompt": ""}',
+        b'{"prompt": 3}',
+        b'{"prompt": "x", "max_new_tokens": 0}',
+        b'{"prompt": "x", "max_new_tokens": "many"}',
+        (
+            '{"prompt": "x", "max_new_tokens": %d}'
+            % (protocol.GENERATE_MAX_NEW_TOKENS_CAP + 1)
+        ).encode(),
+    ):
+        with pytest.raises(ValueError):
+            protocol.decode_generate_request(bad)
+
+
+# --- the paged engine (real jitted programs, CPU) ----------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # 2 slots x 4 pages of 8 tokens = 32-token context -> two prefill
+    # buckets (16, 32); one compile of each + the step program serves the
+    # whole module.
+    return decode_lib.DecodeEngine(
+        "gen-test", max_slots=2, page_size=8, max_pages_per_seq=4,
+    )
+
+
+def test_paged_allocation_frees_on_release(engine):
+    assert engine.pages_in_use == 0
+    slot = engine.acquire_slot(20)  # 20 tokens -> 3 pages of 8
+    try:
+        assert slot is not None
+        assert engine.pages_in_use == 3
+        # active_slots tracks the step mask, which flips at prefill --
+        # an acquired-but-unprefilled slot holds pages but is not active.
+        assert engine.active_slots == 0
+        # Page 0 is the trash page: never handed to a sequence.
+        assert 0 not in engine._slot_pages[slot]
+    finally:
+        engine.release_slot(slot)
+    assert engine.pages_in_use == 0
+    assert engine.active_slots == 0
+
+
+def test_slot_exhaustion_returns_none_not_error(engine):
+    slots = [engine.acquire_slot(8) for _ in range(engine.max_slots)]
+    try:
+        assert all(s is not None for s in slots)
+        assert engine.acquire_slot(8) is None  # full: admission queues
+    finally:
+        for s in slots:
+            engine.release_slot(s)
+
+
+def test_solo_decode_is_deterministic(engine):
+    a = engine.decode_solo("abc", 6)
+    b = engine.decode_solo("abc", 6)
+    assert a == b and len(a) <= 6
+
+
+def test_continuous_batch_streams_bit_identical_to_solo(engine):
+    """The lane's load-bearing invariant: a request decoded in a
+    SHIFTING continuous batch (members joining and retiring around it)
+    yields exactly the tokens of the same request decoded alone.  Mixed
+    prompt lengths cover both prefill buckets; mixed budgets force slot
+    churn mid-flight."""
+    requests = [
+        ("short", 10),
+        ("a much longer prompt string", 4),
+        ("mid-size prompt", 8),
+        ("x", 12),
+        ("long-ish prompt here", 6),
+    ]
+    sched = decode_lib.DecodeScheduler(engine, continuous=True)
+    sched.start()
+    streamed: dict[int, list[int]] = {}
+
+    def drive(i, prompt, mnt):
+        gen = sched.submit(prompt, mnt, rid=f"r{i}")
+        toks = [ev[2] for ev in gen.iter_events(timeout_s=60.0)
+                if ev[0] == "token"]
+        streamed[i] = toks
+
+    threads = [
+        threading.Thread(target=drive, args=(i, p, n))
+        for i, (p, n) in enumerate(requests)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    sched.close()
+    assert sorted(streamed) == list(range(len(requests)))
+    for i, (prompt, mnt) in enumerate(requests):
+        solo = engine.decode_solo(prompt, mnt)
+        assert streamed[i] == solo, (
+            f"req {i}: continuous batch diverged from solo decode"
+        )
+
+
+def test_scheduler_submit_rejects_oversize_prompts(engine):
+    sched = decode_lib.DecodeScheduler(engine, continuous=True)
+    # 40 chars + budget 10 > the 32-token context (with BOS): a 400, not
+    # an admission.
+    with pytest.raises(ValueError):
+        sched.submit("x" * 40, 10)
+    sched.close()
+
+
+def test_scheduler_queue_cap_sheds_with_queuefull(engine):
+    sched = decode_lib.DecodeScheduler(engine, continuous=True, queue_cap=1)
+    # Loop NOT started: the first admission sits in the queue, the second
+    # hits the cap.
+    sched.submit("a", 2)
+    with pytest.raises(QueueFull):
+        sched.submit("b", 2)
+    sched.close()
+
+
+def test_expired_deadline_finishes_as_deadline_without_tokens(engine):
+    sched = decode_lib.DecodeScheduler(engine, continuous=True)
+    sched.start()
+    gen = sched.submit("abc", 4, deadline=Deadline(0.0))
+    events = list(gen.iter_events(timeout_s=30.0))
+    sched.close()
+    assert events == [("done", decode_lib.FINISH_DEADLINE)]
+    assert gen.tokens == []
+
+
+def test_cancel_stops_a_queued_generation(engine):
+    sched = decode_lib.DecodeScheduler(engine, continuous=True)
+    gen = sched.submit("abc", 4)
+    gen.cancel()
+    sched.start()
+    deadline = time.monotonic() + 30.0
+    while not gen.done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    sched.close()
+    assert gen.finish_reason == decode_lib.FINISH_CANCELLED

@@ -141,6 +141,83 @@ def test_warm_main_rc_1_when_any_model_fails(tmp_path, monkeypatch):
     ]) == 1
 
 
+# --- decode bucket ladder (generative lane) ----------------------------------
+
+
+class _FakeDecodeEngine:
+    """Device-free stand-in for runtime.decode.DecodeEngine in warm tests."""
+
+    max_slots = 4
+
+    def __init__(self, model="gen-default"):
+        self.model = model
+
+    def warmup(self):
+        return {
+            "model": self.model,
+            "buckets": {"16": 0.01, "32": 0.01, "64": 0.01},
+            "step_s": 0.01,
+        }
+
+
+def test_warm_learns_decode_grid_when_lane_enabled(tmp_path, monkeypatch):
+    monkeypatch.delenv("KDLT_COMPILE_CACHE_DIR", raising=False)
+    monkeypatch.delenv("KDLT_DECODE", raising=False)
+    root = tmp_path / "models"
+    _save_model(root, "warm-dec")
+    report = warm.warm_models(
+        str(root), buckets=(1,), cache_dir=str(tmp_path / "cache"),
+        engine_factory=_FakeEngine, decode=True,
+        decode_engine_factory=_FakeDecodeEngine,
+    )
+    # The learned ladder is the prompt-length x batch-slot grid: one
+    # prefill program per bucket, one fixed-width step for every slot
+    # composition.
+    grid = report["decode"]["grid"]
+    assert grid["prompt_buckets"] == [16, 32, 64]
+    assert grid["slots"] == 4
+    assert report["decode"]["model"] == "gen-default"
+    assert report["decode"]["step_s"] >= 0
+
+
+def test_warm_decode_follows_kdlt_decode_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("KDLT_COMPILE_CACHE_DIR", raising=False)
+    root = tmp_path / "models"
+    _save_model(root, "warm-nodec")
+    # Lane off (default): the image ladder warms alone.
+    monkeypatch.delenv("KDLT_DECODE", raising=False)
+    report = warm.warm_models(
+        str(root), buckets=(1,), cache_dir=str(tmp_path / "cache"),
+        engine_factory=_FakeEngine, decode_engine_factory=_FakeDecodeEngine,
+    )
+    assert "decode" not in report
+    # Lane on via the same env switch serving pods read.
+    monkeypatch.setenv("KDLT_DECODE", "1")
+    report = warm.warm_models(
+        str(root), buckets=(1,), cache_dir=str(tmp_path / "cache"),
+        engine_factory=_FakeEngine, decode_engine_factory=_FakeDecodeEngine,
+    )
+    assert report["decode"]["grid"]["slots"] == 4
+
+
+def test_warm_decode_failure_is_fail_soft_and_reported(tmp_path, monkeypatch):
+    monkeypatch.delenv("KDLT_COMPILE_CACHE_DIR", raising=False)
+    root = tmp_path / "models"
+    _save_model(root, "warm-decfail")
+
+    def exploding_factory(model="gen-default"):
+        raise RuntimeError("decode compile exploded")
+
+    report = warm.warm_models(
+        str(root), buckets=(1,), cache_dir=str(tmp_path / "cache"),
+        engine_factory=_FakeEngine, decode=True,
+        decode_engine_factory=exploding_factory,
+    )
+    # Image models still warmed; the decode failure is an error entry.
+    assert "error" not in report["models"]["warm-decfail"]
+    assert report["decode"]["error"] == "decode compile exploded"
+
+
 # --- warmup provenance classification (runtime/engine.py) --------------------
 
 

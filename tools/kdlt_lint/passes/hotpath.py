@@ -40,6 +40,11 @@ HOT_PATH_ROOTS = (
     # half is what overlaps round N+1 with round N's collective, so a host
     # sync here stalls the whole fleet's pipeline, not one process.
     (f"{PACKAGE}/parallel/crosshost.py", "CrossHostForward", "predict_async"),
+    # The decode token loop's per-step dispatch: one host sync here is
+    # paid EVERY token of EVERY active generation, so the step must stay
+    # async -- materialization happens once per iteration in the scheduler
+    # loop (emit_tokens), never inside the step dispatch itself.
+    (f"{PACKAGE}/runtime/decode.py", "DecodeEngine", "step_async"),
 )
 
 SYNC_NP_FUNCS = {"numpy.asarray", "numpy.array"}

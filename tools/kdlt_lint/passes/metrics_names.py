@@ -29,7 +29,7 @@ CENTRAL_LABELS = {
 }
 CENTRAL_PREFIXES = (
     "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
-    "kdlt_incident_", "kdlt_mesh_",
+    "kdlt_incident_", "kdlt_mesh_", "kdlt_decode_",
 )
 CENTRAL_NAMES = ("kdlt_engine_warm_source",)
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
