@@ -4263,6 +4263,255 @@ def bench_cache_ab(duration_s=6.0, device_ms=50.0, deadline_ms=800.0,
     return out, 0 if ok else 1
 
 
+def bench_ingest_ab(n_images=200, source_px=768, input_px=64, clients=8,
+                    seed=0):
+    """Raw-bytes ingest wire A/B: decode at the model tier vs the gateway.
+
+    A REAL Gateway fronts ONE stub-backed ModelServer; ``clients``
+    closed-loop threads drive ``n_images`` single-image ``apply_model``
+    calls over a catalog of distinct smooth-gradient JPEGs
+    (``source_px``^2 source, ``input_px``^2 model input: a small file
+    whose decode cost is proportional to source pixels -- the workload
+    the bytes wire is for).  Two arms on the same seeded schedule:
+
+    - bytes wire: KDLT_INGEST negotiated on both tiers; the gateway
+      forwards fetched bytes verbatim and the model tier decodes.
+    - tensor wire: ingest off on both tiers (the old posture); the
+      gateway decodes + preprocesses and ships the uint8 tensor.
+
+    The decoded-uint8 cache is forced OFF on both tiers for the run
+    (KDLT_CACHE_DECODED_MB=0) so the A/B measures the distinct-content
+    steady state, not cache hits.  Gateway-tier CPU is isolated with
+    per-thread ``time.thread_time()`` around the ``apply_model`` loop
+    (the model tier's decode pool runs in other threads and is excluded
+    -- that is the point: the work MOVED).  Wire bytes are counted by
+    wrapping the gateway's single upstream POST seam.
+
+    Returns (json_dict, rc); rc=0 iff no request errored in either arm
+    AND (bytes-arm img/s >= 1.3x tensor arm OR gateway CPU/image >= 2x
+    lower) AND bytes-arm wire bytes/image <= 1.2x the mean encoded blob
+    size AND per-image scores are identical across wires AND the bytes
+    arm really used the bytes wire (zero fallbacks).
+    """
+    import itertools
+    import tempfile
+    import threading
+    from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+    from PIL import Image
+
+    from kubernetes_deep_learning_tpu.export import artifact as art
+    from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+    from kubernetes_deep_learning_tpu.runtime.stub import StubEngine
+    from kubernetes_deep_learning_tpu.serving import cache as cache_lib
+    from kubernetes_deep_learning_tpu.serving.gateway import Gateway
+    from kubernetes_deep_learning_tpu.serving.model_server import ModelServer
+
+    class QuietImageHandler(SimpleHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+    spec = register_spec(
+        ModelSpec(
+            name="ingest-stub",
+            family="xception",  # never instantiated by StubEngine
+            input_shape=(input_px, input_px, 3),
+            labels=("a", "b", "c"),
+        )
+    )
+    rng = np.random.default_rng(seed)
+    universe = min(32, n_images)
+    img_dir = tempfile.mkdtemp(prefix="kdlt-ingest-img-")
+    yy, xx = np.mgrid[0:source_px, 0:source_px]
+    for k in range(universe):
+        # Smooth phase-shifted gradients: distinct content per file (the
+        # decoded cache is content-addressed), small JPEG, full-cost
+        # decode.  Noise would also decode slowly but bloats the file,
+        # which is the opposite of the workload this wire targets.
+        ph = 2.0 * np.pi * k / universe
+        img = np.stack([
+            127.5 + 127.5 * np.sin(xx / 41.0 + ph),
+            127.5 + 127.5 * np.sin(yy / 53.0 + 2.0 * ph),
+            127.5 + 127.5 * np.sin((xx + yy) / 67.0 + 3.0 * ph),
+        ], axis=-1).astype(np.uint8)
+        Image.fromarray(img).save(
+            os.path.join(img_dir, f"img{k}.jpg"), quality=85
+        )
+    blob_sizes = [
+        os.path.getsize(os.path.join(img_dir, f"img{k}.jpg"))
+        for k in range(universe)
+    ]
+    mean_blob = float(np.mean(blob_sizes))
+    img_httpd = HTTPServer(
+        ("127.0.0.1", 0), partial(QuietImageHandler, directory=img_dir)
+    )
+    threading.Thread(target=img_httpd.serve_forever, daemon=True).start()
+    urls = [
+        f"http://127.0.0.1:{img_httpd.server_address[1]}/img{k}.jpg"
+        for k in range(universe)
+    ]
+    order = rng.integers(0, universe, size=n_images)
+    log(
+        f"ingest A/B: {n_images} images over {universe} distinct "
+        f"{source_px}x{source_px} JPEGs (mean {mean_blob / 1024:.1f} KiB) "
+        f"-> {input_px}x{input_px} input, {clients} client threads, "
+        f"decoded cache off, seed {seed}"
+    )
+
+    def run_arm(bytes_wire: bool) -> tuple[dict, dict]:
+        root = tempfile.mkdtemp(prefix="kdlt-ingest-")
+        art.save_artifact(
+            art.version_dir(root, spec.name, 1), spec, {"params": {}}, None, {}
+        )
+        server = ModelServer(
+            root, port=0, buckets=(1, 2), max_delay_ms=1.0, host="127.0.0.1",
+            ingest=bytes_wire,
+            engine_factory=lambda a, **kw: StubEngine(a, **kw),
+        )
+        server.warmup()
+        server.start()
+        gw = Gateway(
+            serving_host=f"127.0.0.1:{server.port}", model=spec.name,
+            port=0, host="127.0.0.1", cache=False, ingest=bytes_wire,
+        )
+        gw.start()
+        gw.spec  # negotiate the contract (and ingest caps) off the clock
+        wire = {"bytes": 0, "posts": 0}
+        orig_post = gw._post_once
+
+        def counting_post(replica, body, *a, **kw):
+            wire["bytes"] += len(body)
+            wire["posts"] += 1
+            return orig_post(replica, body, *a, **kw)
+
+        gw._post_once = counting_post
+        idx = itertools.count()
+        cpu = [0.0] * clients
+        done = [0] * clients
+        errors = [0] * clients
+
+        def worker(w: int) -> None:
+            t0 = time.thread_time()
+            while True:
+                i = next(idx)
+                if i >= n_images:
+                    break
+                try:
+                    gw.apply_model(urls[int(order[i])])
+                    done[w] += 1
+                except Exception:  # noqa: BLE001 - the error count is the gate
+                    errors[w] += 1
+            cpu[w] = time.thread_time() - t0
+
+        t_start = time.monotonic()
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        wall = time.monotonic() - t_start
+        n_done = sum(done)
+        # Per-image score parity probes (off the clock, still counted in
+        # the wire tally -- per-post averaging keeps that fair).
+        scores = {}
+        for k in range(universe):
+            try:
+                scores[k] = gw.apply_model(urls[k])
+            except Exception:  # noqa: BLE001
+                errors[0] += 1
+        m = gw._m_ingest
+        arm = {
+            "wire": "bytes" if bytes_wire else "tensor",
+            "images": n_done,
+            "errors": sum(errors),
+            "wall_s": round(wall, 3),
+            "img_per_s": round(n_done / max(wall, 1e-9), 1),
+            "gateway_cpu_ms_per_img": round(
+                sum(cpu) * 1e3 / max(n_done, 1), 3
+            ),
+            "wire_bytes_per_img": round(wire["bytes"] / max(wire["posts"], 1)),
+            "bytes_requests": int(m["bytes_requests"].value),
+            "fallbacks": {
+                reason: int(c.value) for reason, c in m["fallbacks"].items()
+            },
+        }
+        gw.shutdown()
+        server.shutdown()
+        log(
+            f"  wire={arm['wire']:6s}: {arm['img_per_s']:7.1f} img/s, "
+            f"gateway CPU {arm['gateway_cpu_ms_per_img']:6.2f} ms/img, "
+            f"{arm['wire_bytes_per_img']} wire B/img, "
+            f"{arm['errors']} errors"
+        )
+        return arm, scores
+
+    # The decoded-uint8 cache is a separate win with its own tests; force
+    # it off on BOTH tiers so the arms compare decode placement, not
+    # cache hits (every request would otherwise hit after round one).
+    saved_mb = os.environ.get(cache_lib.DECODED_MB_ENV)
+    os.environ[cache_lib.DECODED_MB_ENV] = "0"
+    try:
+        arm_bytes, scores_bytes = run_arm(True)
+        arm_tensor, scores_tensor = run_arm(False)
+    finally:
+        if saved_mb is None:
+            os.environ.pop(cache_lib.DECODED_MB_ENV, None)
+        else:
+            os.environ[cache_lib.DECODED_MB_ENV] = saved_mb
+        img_httpd.shutdown()
+    parity = all(
+        json.dumps(scores_bytes.get(k), sort_keys=True)
+        == json.dumps(scores_tensor.get(k), sort_keys=True)
+        for k in range(universe)
+    )
+    speedup = arm_bytes["img_per_s"] / max(arm_tensor["img_per_s"], 1e-9)
+    cpu_ratio = arm_tensor["gateway_cpu_ms_per_img"] / max(
+        arm_bytes["gateway_cpu_ms_per_img"], 1e-9
+    )
+    wire_ratio = arm_bytes["wire_bytes_per_img"] / max(mean_blob, 1e-9)
+    used_bytes_wire = (
+        arm_bytes["bytes_requests"] > 0
+        and sum(arm_bytes["fallbacks"].values()) == 0
+    )
+    log(
+        f"  speedup {speedup:.2f}x img/s, gateway CPU ratio "
+        f"{cpu_ratio:.2f}x, wire {wire_ratio:.2f}x encoded blob, parity "
+        f"{'identical' if parity else 'DIVERGED'}"
+    )
+    ok = (
+        arm_bytes["errors"] == 0
+        and arm_tensor["errors"] == 0
+        and (speedup >= 1.3 or cpu_ratio >= 2.0)
+        and wire_ratio <= 1.2
+        and parity
+        and used_bytes_wire
+    )
+    out = {
+        "metric": (
+            f"raw-bytes ingest wire A/B ({source_px}x{source_px} JPEG -> "
+            f"{input_px}x{input_px} input, {clients} clients, decoded "
+            f"cache off): decode at the model tier vs the gateway"
+        ),
+        "value": round(cpu_ratio, 2),
+        "unit": "x lower gateway CPU per image (bytes wire)",
+        "speedup_img_per_s": round(speedup, 2),
+        "cpu_ratio": round(cpu_ratio, 2),
+        "wire_ratio_vs_encoded": round(wire_ratio, 3),
+        "mean_encoded_blob_bytes": round(mean_blob),
+        "parity_identical": parity,
+        "used_bytes_wire": used_bytes_wire,
+        "n_images": n_images,
+        "universe": universe,
+        "clients": clients,
+        "seed": seed,
+        "arms": {"bytes": arm_bytes, "tensor": arm_tensor},
+    }
+    return out, 0 if ok else 1
+
+
 def bench_trace_breakdown(n_requests=30, device_ms=60.0, deadline_ms=5000.0,
                           max_delay_ms=1.0):
     """Span-trace latency attribution on a stub serving stack.
@@ -5106,6 +5355,34 @@ def main() -> int:
         help="deterministic seed for the --cache-ab URL schedule",
     )
     p.add_argument(
+        "--ingest-ab", type=int, default=0, metavar="IMAGES",
+        help="INSTEAD of the sweep: raw-bytes ingest wire A/B -- drive "
+             "this many single-image requests through a real gateway + "
+             "stub model tier with the bytes wire (model-tier decode) vs "
+             "the legacy tensor wire (gateway decode), decoded cache off "
+             "on both tiers (no device needed; rc=0 iff the bytes arm "
+             "wins >=1.3x img/s OR >=2x lower gateway CPU/image, its "
+             "wire bytes/image stay <=1.2x the encoded blob, scores are "
+             "identical across wires, and zero fallbacks fired)",
+    )
+    p.add_argument(
+        "--ingest-size", type=int, default=768,
+        help="source JPEG edge (pixels) for --ingest-ab; decode cost "
+             "scales with this, file size barely does (smooth gradients)",
+    )
+    p.add_argument(
+        "--ingest-input", type=int, default=64,
+        help="model input edge (pixels) for --ingest-ab",
+    )
+    p.add_argument(
+        "--ingest-clients", type=int, default=8,
+        help="closed-loop client threads for --ingest-ab",
+    )
+    p.add_argument(
+        "--ingest-seed", type=int, default=0,
+        help="deterministic seed for the --ingest-ab image schedule",
+    )
+    p.add_argument(
         "--decode-ab", type=int, default=0, metavar="REQUESTS",
         help="INSTEAD of the sweep: generative-lane continuous-batching "
              "A/B -- drive this many mixed-prompt-length generations "
@@ -5227,7 +5504,8 @@ def main() -> int:
                      "batcher_sweep", "host_saturation", "overload_ab",
                      "chaos_ab", "churn_ab", "cache_ab", "trace_breakdown",
                      "multimodel_ab", "obs_overhead_ab", "quant_ab",
-                     "tenant_ab", "incident_ab", "mesh_ab", "decode_ab"):
+                     "tenant_ab", "incident_ab", "mesh_ab", "decode_ab",
+                     "ingest_ab"):
             if getattr(args, flag):
                 mode = flag
                 break
@@ -5332,6 +5610,13 @@ def main() -> int:
                 "bytes_slack": args.mesh_bytes_slack,
                 "floor_frac": args.mesh_floor,
                 "seed": args.mesh_seed,
+            },
+            "ingest": {
+                "images": args.ingest_ab,
+                "source_px": args.ingest_size,
+                "input_px": args.ingest_input,
+                "clients": args.ingest_clients,
+                "seed": args.ingest_seed,
             },
             "decode": {
                 "requests": args.decode_ab,
@@ -5543,6 +5828,17 @@ def main() -> int:
             universe=args.cache_universe,
             probe_n=args.cache_probe_n,
             seed=args.cache_seed,
+        )
+        print(json.dumps(out), flush=True)
+        return rc
+
+    if args.ingest_ab > 0:
+        out, rc = bench_ingest_ab(
+            n_images=args.ingest_ab,
+            source_px=args.ingest_size,
+            input_px=args.ingest_input,
+            clients=args.ingest_clients,
+            seed=args.ingest_seed,
         )
         print(json.dumps(out), flush=True)
         return rc

@@ -113,6 +113,15 @@ _SHUTDOWN, _PREDICT, _RELOAD, _PREDICT_FAST = 0, 1, 2, 3
 # compression off the wire (legacy flags, raw payload) is byte-identical
 # to pre-diet builds.
 _PREDICT_Z, _PREDICT_FAST_Z = 4, 5
+# Raw-bytes ingest variants (GUIDE 10q): payload is the packed ENCODED
+# JPEG/PNG blobs (protocol.encode_bytes_predict_request), aux still the
+# bucket; every process decodes locally (ops.preprocess.BatchDecoder --
+# deterministic, so the fleet stays bit-identical).  The leader decodes
+# BEFORE broadcasting: a corrupt client blob raises there (-> HTTP 400)
+# and followers only ever receive decodable rounds, so bad bytes can
+# never wedge or gang-restart the fleet.  No codec composition with
+# _PREDICT_Z: the blobs are already entropy-coded.
+_PREDICT_ENC, _PREDICT_ENC_FAST = 6, 7
 
 # Broadcast payload codec: "", "0", "off", "none" -> raw legacy wire;
 # "1"/"on"/"zlib" -> zlib level 1 (stdlib, fast, padded uint8 batches
@@ -793,24 +802,78 @@ class CrossHostForward:
         import jax
 
         assert jax.process_index() == 0, "predict_async() is the leader's call"
-        traces = tuple(t for t in traces if t is not None)
         n = images.shape[0]
         bucket = self.bucket_for(n)
         pad = np.zeros((bucket - n, *self.spec.input_shape), np.uint8)
         batch = np.concatenate([images, pad])
+        return self._leader_dispatch(batch, n, None, traces)
+
+    def predict_encoded_async(self, blobs, traces=()):
+        """Leader entry for the raw-bytes ingest wire (GUIDE 10q): encoded
+        JPEG/PNG blobs in, ``(handle, n)`` out, same pipelining contract
+        as predict_async.
+
+        Decodes FIRST (BatchDecoder; a corrupt blob raises ValueError here,
+        before anything touches the control channel), then broadcasts the
+        packed encoded blobs -- typically 10-50x smaller than the padded
+        uint8 tensor the legacy flags carry -- and every follower decodes
+        the same bytes with the same deterministic host kernels, so the
+        fleet's batches stay bit-identical.
+        """
+        import jax
+
+        assert jax.process_index() == 0, (
+            "predict_encoded_async() is the leader's call"
+        )
+        from kubernetes_deep_learning_tpu.serving import protocol
+
+        decoded = self._ingest_decoder().decode_batch(
+            list(blobs), self.spec.input_shape[:2],
+            filter=self.spec.resize_filter,
+        )
+        n = decoded.shape[0]
+        bucket = self.bucket_for(n)
+        pad = np.zeros((bucket - n, *self.spec.input_shape), np.uint8)
+        batch = np.concatenate([decoded, pad])
+        payload = protocol.encode_bytes_predict_request(blobs)
+        return self._leader_dispatch(batch, n, payload, traces)
+
+    # Lazily-built decode pool; class-level default so neither the leader
+    # nor the follower construction path needs wiring.
+    _decoder = None
+
+    def _ingest_decoder(self):
+        """Lazy per-process decode pool (leader and followers alike)."""
+        if self._decoder is None:
+            with self._round_lock:
+                if self._decoder is None:
+                    from kubernetes_deep_learning_tpu.ops import preprocess
+
+                    self._decoder = preprocess.BatchDecoder()
+        return self._decoder
+
+    def _leader_dispatch(self, batch, n, enc_payload, traces):
+        """Shared broadcast+dispatch round body for both leader wires:
+        ``enc_payload`` None -> legacy tensor wire (codec-compressible);
+        else the packed encoded blobs to broadcast verbatim."""
+        traces = tuple(t for t in traces if t is not None)
+        bucket = batch.shape[0]
         self._slots.acquire()
         seq = None
         try:
             with self._round_lock:
                 fast = self.resolve_mode() == "fast"
                 key = ("fast" if fast else "exact", bucket)
-                raw = batch.tobytes()
-                if self._xh_codec is not None:
+                raw_len = batch.nbytes
+                if enc_payload is not None:
+                    flag = _PREDICT_ENC_FAST if fast else _PREDICT_ENC
+                    payload = enc_payload
+                elif self._xh_codec is not None:
                     flag = _PREDICT_FAST_Z if fast else _PREDICT_Z
-                    payload = _compress_payload(self._xh_codec, raw)
+                    payload = _compress_payload(self._xh_codec, batch.tobytes())
                 else:
                     flag = _PREDICT_FAST if fast else _PREDICT
-                    payload = raw
+                    payload = batch.tobytes()
                 seq = self._seq
                 self._seq += 1
                 self._watch.begin(seq, key)
@@ -836,7 +899,7 @@ class CrossHostForward:
                         # receipt (equal when compression is off).
                         tr.record(
                             "crosshost.broadcast", w0, w1 - w0, bucket=bucket,
-                            raw_bytes=len(raw), wire_bytes=len(payload),
+                            raw_bytes=raw_len, wire_bytes=len(payload),
                         )
         except BaseException:
             if seq is not None:
@@ -1020,7 +1083,8 @@ class CrossHostForward:
                     # the raw payload untouched (byte-identical wire when
                     # the leader runs with compression off).
                     payload = _decompress_payload(payload)
-                fast = flag in (_PREDICT_FAST, _PREDICT_FAST_Z)
+                encoded = flag in (_PREDICT_ENC, _PREDICT_ENC_FAST)
+                fast = flag in (_PREDICT_FAST, _PREDICT_FAST_Z, _PREDICT_ENC_FAST)
                 if fast and not self._fast_possible:
                     # The leader resolved "fast" where this process statically
                     # cannot build it: the fleet is misconfigured (mixed code
@@ -1030,9 +1094,31 @@ class CrossHostForward:
                         "received PREDICT_FAST but the fused path does not "
                         "resolve on this process; fleet config mismatch"
                     )
-                batch = np.frombuffer(payload, np.uint8).reshape(
-                    int(aux), *self.spec.input_shape
-                )
+                if encoded:
+                    # Raw-bytes ingest round: decode the broadcast blobs
+                    # with the same deterministic host kernels the leader
+                    # used (it already decoded this exact payload, so a
+                    # decode failure here is a code-version mismatch, not
+                    # client data -- die loudly like the fast-mismatch
+                    # case) and zero-pad to the bucket the leader padded to.
+                    from kubernetes_deep_learning_tpu.serving import protocol
+
+                    blobs = protocol.decode_bytes_predict_request(payload)
+                    decoded = self._ingest_decoder().decode_batch(
+                        blobs, self.spec.input_shape[:2],
+                        filter=self.spec.resize_filter,
+                    )
+                    if decoded.shape[0] != int(aux):
+                        pad = np.zeros(
+                            (int(aux) - decoded.shape[0], *self.spec.input_shape),
+                            np.uint8,
+                        )
+                        decoded = np.concatenate([decoded, pad])
+                    batch = decoded
+                else:
+                    batch = np.frombuffer(payload, np.uint8).reshape(
+                        int(aux), *self.spec.input_shape
+                    )
                 # Backpressure: once ``depth`` rounds are in flight, stop
                 # reading the channel until the completion thread catches
                 # up -- TCP flow control then pushes back on the leader,
@@ -1337,6 +1423,17 @@ class CrossHostEngine:
         the device sync.  Backpressure rides xh's in-flight budget."""
         self._check_images(images)
         handle, n = self._xh.predict_async(images, traces=traces)
+        if self._m_images is not None:
+            self._m_images.inc(n)
+        return handle, n
+
+    def predict_encoded_async(self, blobs, traces=()):
+        """Raw-bytes ingest hook (GUIDE 10q): the model server hands the
+        wire's encoded blobs straight through, so the cross-host broadcast
+        carries compact JPEG/PNG bytes instead of the padded uint8 tensor
+        and every process decodes locally.  ValueError (corrupt blob)
+        raises here on the leader before any broadcast -> HTTP 400."""
+        handle, n = self._xh.predict_encoded_async(blobs, traces=traces)
         if self._m_images is not None:
             self._m_images.inc(n)
         return handle, n

@@ -83,6 +83,36 @@ DONATE_ENV = "KDLT_DONATE"
 WARM_CACHE_HIT_ENV = "KDLT_WARM_CACHE_HIT_S"
 DEFAULT_WARM_CACHE_HIT_S = 1.0
 
+# Device-resize staging for the raw-bytes ingest path (GUIDE 10q):
+# KDLT_INGEST_DEVICE_RESIZE=HxW makes the decode stage stop resizing on
+# host at HxW and hands the engine that staging resolution; a fused jitted
+# program then resizes to spec.input_shape ON DEVICE (jax.image.resize)
+# ahead of the forward.  Default OFF: jax.image.resize is not bit-exact
+# with the host kernels (native/PIL), and the serving contract is that
+# bytes-wire logits equal tensor-wire logits -- so host resize stays
+# authoritative and this knob is an explicit staging/experiment opt-in.
+INGEST_DEVICE_RESIZE_ENV = "KDLT_INGEST_DEVICE_RESIZE"
+
+
+def ingest_device_resize(explicit: str | None = None) -> tuple[int, int] | None:
+    """Parse the staging resolution: 'HxW' -> (H, W); unset/off -> None."""
+    raw = explicit if explicit is not None else os.environ.get(
+        INGEST_DEVICE_RESIZE_ENV, ""
+    )
+    raw = (raw or "").strip().lower()
+    if not raw or raw in ("0", "off", "false", "no"):
+        return None
+    try:
+        h_s, w_s = raw.split("x")
+        h, w = int(h_s), int(w_s)
+    except ValueError:
+        raise ValueError(
+            f"{INGEST_DEVICE_RESIZE_ENV} must be 'HxW' (e.g. 512x512), got {raw!r}"
+        ) from None
+    if h <= 0 or w <= 0:
+        raise ValueError(f"{INGEST_DEVICE_RESIZE_ENV} dims must be positive, got {raw!r}")
+    return (h, w)
+
 
 def warm_cache_hit_threshold_s() -> float:
     try:
@@ -1343,6 +1373,110 @@ class InferenceEngine:
                         self._live_forward(jnp.dtype(self._compute_dtype))
                     )
         return self._jitted_f32
+
+    # --- raw-bytes ingest dispatch (GUIDE 10q) ---------------------------
+    # Class-level defaults so the three construction paths (mesh spmd,
+    # mesh replicated, single-device) need no per-path __init__ wiring;
+    # the first predict_ingest_async resolves and caches them lazily.
+    _ingest_staging: tuple[int, int] | None = None
+    _ingest_staging_resolved = False
+    _ingest_jitted = None
+
+    def _resolve_ingest_staging(self) -> tuple[int, int] | None:
+        if not self._ingest_staging_resolved:
+            with self._f32_lock:
+                if not self._ingest_staging_resolved:
+                    staging = None if self.mesh is not None else ingest_device_resize()
+                    if staging == tuple(self.spec.input_shape[:2]):
+                        staging = None  # no-op resize: use the plain forward
+                    self._ingest_staging = staging
+                    self._ingest_staging_resolved = True
+        return self._ingest_staging
+
+    @property
+    def ingest_source_shape(self) -> tuple[int, int, int]:
+        """Per-image (H, W, C) the bytes-wire decode stage must produce.
+
+        spec.input_shape normally; the staging resolution when
+        KDLT_INGEST_DEVICE_RESIZE is set (mesh engines ignore the knob:
+        the fused resize program is single-device, and the mesh jit's
+        sharding constraints are built for input_shape).
+        """
+        staging = self._resolve_ingest_staging()
+        if staging is None:
+            return self.spec.input_shape
+        return (*staging, self.spec.input_shape[2])
+
+    def _ingest_fused(self):
+        """Lazily build the fused device resize -> forward program.
+
+        One jitted program: uint8 staging batch -> f32 -> jax.image.resize
+        to spec HxW (method from spec.resize_filter) -> round/clip back to
+        uint8 -> the live forward (whose first op is the fused-into-conv
+        normalization, so resize+normalize+conv all sit in one XLA
+        program, one H2D of the staging-resolution batch).  Requires an
+        in-tree model family (exported-only artifacts have no live
+        forward); _live_forward raises for those, at first use.
+        """
+        if self._ingest_jitted is None:
+            with self._f32_lock:
+                if self._ingest_jitted is None:
+                    import jax
+                    import jax.numpy as jnp
+
+                    h, w, c = self.spec.input_shape
+                    method = (
+                        "nearest" if self.spec.resize_filter == "nearest" else "linear"
+                    )
+                    inner = self._live_forward(jnp.dtype(self._compute_dtype))
+
+                    def fused(variables, batch):
+                        x = batch.astype(jnp.float32)
+                        x = jax.image.resize(
+                            x, (batch.shape[0], h, w, c), method=method
+                        )
+                        x = jnp.clip(jnp.round(x), 0.0, 255.0).astype(jnp.uint8)
+                        return inner(variables, x)
+
+                    self._ingest_jitted = _donate_jit(fused, self._donate)
+        return self._ingest_jitted
+
+    def predict_ingest_async(self, images: np.ndarray):
+        """Bytes-wire dispatch hook: uint8 batch at ``ingest_source_shape``.
+
+        Default (no staging): exactly predict_async -- the decode stage
+        already resized to spec.input_shape on host (bit-exact with the
+        legacy gateway preprocessing), and normalization fuses into the
+        first conv on device, so bytes-wire logits equal tensor-wire
+        logits by construction.  With KDLT_INGEST_DEVICE_RESIZE=HxW the
+        decode stage hands over HxW uint8 and the fused program resizes
+        on device ahead of the forward (approximate numerics; staging
+        only).  Same aliasing/pipelining contract as predict_async.
+        """
+        staging = self._resolve_ingest_staging()
+        if staging is None:
+            return self.predict_async(images)
+        # kdlt-lint: disable=hot-path-sync -- normalizes the caller's host input (list -> ndarray); no device handle is involved, so nothing can block on device work
+        images = np.asarray(images)
+        src = self.ingest_source_shape
+        if images.ndim != 4 or images.shape[1:] != src:
+            raise ValueError(f"expected (N, {src}), got {images.shape}")
+        if images.dtype != np.uint8:
+            raise ValueError(
+                f"predict_ingest_async takes uint8 images, got {images.dtype}"
+            )
+        n = images.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket != n:
+            pad = np.zeros((bucket - n, *src), images.dtype)
+            batch = np.concatenate([images, pad], axis=0)
+        else:
+            batch = images
+        self._ingest_fused()  # build outside the dispatch lock
+        with self._lock:
+            # kdlt-lint: disable=lock-around-jit -- same serialized-enqueue contract as predict_async: dispatch is async, the lock covers only the enqueue, and donated-buffer dispatches must not interleave
+            logits = self._ingest_jitted(self._variables, batch)
+        return logits, n
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:

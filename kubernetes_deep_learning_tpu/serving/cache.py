@@ -57,6 +57,9 @@ TTL_ENV = "KDLT_CACHE_TTL_S"
 MAX_MB_ENV = "KDLT_CACHE_MAX_MB"
 NEG_TTL_ENV = "KDLT_CACHE_NEG_TTL_S"
 SWR_ENV = "KDLT_CACHE_SWR_S"
+# Decoded-uint8 tier byte budget (DecodedCache below); 0 disables the tier.
+DECODED_MB_ENV = "KDLT_CACHE_DECODED_MB"
+DEFAULT_DECODED_MB = 32.0
 
 # Staleness ceiling between an artifact reload and the first miss that
 # teaches the gateway the new hash; 60 s matches the version watcher's
@@ -509,4 +512,132 @@ class ResponseCache:
                 "evictions": dict(self.evictions),
                 "entries_by_model": per_model,
                 "artifact_hashes": dict(self._hashes),
+            }
+
+
+# --- decoded-uint8 tier (cache carry-over #2) ------------------------------
+
+def decoded_params(input_shape, resize_filter: str) -> str:
+    """The canonical preprocess-params half of a decoded-tier key.  Both
+    tiers spell it through this one function: a gateway and a model server
+    disagreeing on the params string would silently never share entries."""
+    return f"{tuple(input_shape)}|{resize_filter}"
+
+
+def decoded_key(payload: bytes, params: str) -> str:
+    """(content bytes, resolved preprocess params) -> decoded-tier key.
+
+    Deliberately EXCLUDES the model name: two models with the same input
+    contract decode the same image to the same pixels, so a cross-model
+    hit skips the decode+resize entirely.  Content-addressed keys make
+    entries immutable -- no TTL, no artifact invalidation."""
+    h = hashlib.sha256()
+    h.update(payload)
+    h.update(b"|")
+    h.update(params.encode())
+    return h.hexdigest()
+
+
+class DecodedCache:
+    """Bounded LRU of decoded+resized uint8 image tensors.
+
+    The decode stage's memo (GUIDE 10q): keyed by
+    :func:`decoded_key` so identical image content requested for ANY
+    model with the same input contract skips JPEG/PNG decode and resize.
+    Lives on both tiers -- the gateway's legacy preprocess path and the
+    model tier's bytes-wire decode stage consult one instance each.
+
+    Entries are immutable by contract: callers must never mutate a
+    returned array (get() marks it read-only to enforce that cheaply).
+    KDLT_CACHE_DECODED_MB=0 disables the tier (get/put become no-ops).
+    All kdlt_cache_decoded_* series are minted centrally in
+    utils/metrics.py.
+    """
+
+    def __init__(
+        self,
+        registry: metrics_lib.Registry | None = None,
+        max_mb: float | None = None,
+    ):
+        max_mb = max_mb if max_mb is not None else _env_float(
+            DECODED_MB_ENV, DEFAULT_DECODED_MB
+        )
+        self.max_bytes = int(max_mb * 1024 * 1024)
+        self._entries: "OrderedDict[str, object]" = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0              # guarded-by: _lock
+        self._lock = threading.Lock()
+        self.hits = 0                # guarded-by: _lock
+        self.misses = 0              # guarded-by: _lock
+        self.evictions = 0           # guarded-by: _lock
+        self._m = (
+            metrics_lib.cache_decoded_metrics(registry)
+            if registry is not None else None
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    def get(self, key: str):
+        """Hit -> the decoded uint8 array (read-only view) + LRU touch;
+        miss -> None."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            arr = self._entries.get(key)
+            if arr is None:
+                self.misses += 1
+                if self._m is not None:
+                    self._m["misses"].inc()
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            if self._m is not None:
+                self._m["hits"].inc()
+            return arr
+
+    def put(self, key: str, arr) -> bool:
+        """Store one decoded tensor; returns False when disabled or the
+        tensor alone exceeds the whole byte budget."""
+        if not self.enabled or arr.nbytes > self.max_bytes:
+            return False
+        stored = arr.copy() if not arr.flags.c_contiguous else arr
+        stored.setflags(write=False)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = stored
+            self._bytes += stored.nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                oldest = next(iter(self._entries))
+                if oldest == key:
+                    break  # never evict the entry being inserted
+                victim = self._entries.pop(oldest)
+                self._bytes -= victim.nbytes
+                self.evictions += 1
+                if self._m is not None:
+                    self._m["evictions"].inc()
+            self._refresh_gauges_locked()
+        return True
+
+    def _refresh_gauges_locked(self) -> None:
+        if self._m is None:
+            return
+        self._m["resident"].set(float(self._bytes))
+        self._m["entries"].set(float(len(self._entries)))
+
+    def stats(self) -> dict:
+        """The /debug/cache "decoded" section."""
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._entries),
+                "resident_bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_ratio": round(self.hits / total, 4) if total else 0.0,
+                "evictions": self.evictions,
             }

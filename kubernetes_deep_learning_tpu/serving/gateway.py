@@ -109,12 +109,35 @@ UPSTREAM_RETRY_BACKOFF_S = 0.05  # one retry on the model tier's 503 overload
 MIN_RETRY_BUDGET_S = 0.05    # a 503 retry must leave at least this much
                              # deadline budget AFTER the backoff sleep, or
                              # the retry is skipped (it cannot finish anyway)
-MAX_BATCH_FETCHERS = 8       # concurrent image downloads per batch request
+MAX_BATCH_FETCHERS = 8       # default concurrent image downloads per batch
+                             # request; $KDLT_FETCH_CONCURRENCY overrides
+                             # (GUIDE Appendix A) -- the constant stays as
+                             # the documented default and back-compat alias
+FETCH_CONCURRENCY_ENV = "KDLT_FETCH_CONCURRENCY"
 MAX_URLS_PER_REQUEST = 256   # hard cap: bounds per-request image memory
 MAX_PREDICT_BODY_BYTES = 4 * 1024 * 1024  # /predict bodies are JSON of up to
 # 256 URLs -- a few KB each covers any sane client; checked against
 # Content-Length BEFORE reading so an adversarial multi-GB body cannot
 # exhaust gateway memory (the model tier has the equivalent pre-read cap).
+
+
+def resolve_fetch_concurrency(explicit: int | None = None) -> int:
+    """Explicit arg > $KDLT_FETCH_CONCURRENCY > MAX_BATCH_FETCHERS; >= 1."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    raw = os.environ.get(FETCH_CONCURRENCY_ENV, "")
+    try:
+        return max(1, int(raw)) if raw.strip() else MAX_BATCH_FETCHERS
+    except ValueError:
+        return MAX_BATCH_FETCHERS
+
+
+class _BytesWireRejected(Exception):
+    """A bytes-wire POST came back 400/415: the replica pool is mixed-version
+    (stale negotiation) or the server was flipped to KDLT_INGEST=0 after
+    discovery.  Internal signal only -- the caller decodes at the gateway
+    and resends the SAME request on the tensor wire, so the client never
+    sees the rollout seam."""
 
 
 class UpstreamError(RuntimeError):
@@ -164,6 +187,8 @@ class Gateway:
         incident_dir: str | None = None,
         incident_triggers: str | None = None,
         incident_dedup_s: float | None = None,
+        ingest: bool | None = None,
+        fetch_concurrency: int | None = None,
     ):
         # request_log: print one traced line per /predict (rid, status,
         # duration).  Off by default for in-process use (tests, benches);
@@ -280,6 +305,20 @@ class Gateway:
             else None
         )
         self._singleflight = cache_lib.SingleFlight()
+        # Raw-bytes ingest wire (GUIDE 10q): when enabled here (KDLT_INGEST,
+        # default on; ``ingest`` arg overrides) AND the model tier
+        # advertised the capability during spec discovery (X-Kdlt-Ingest),
+        # fetched JPEG/PNG bytes travel upstream verbatim and the MODEL
+        # tier decodes -- this tier's Python stops paying decode+resize
+        # CPU per image.  Unsniffable blobs and mixed-version replicas
+        # fall back per request to the legacy tensor wire (reason-labelled
+        # counters below).  The decoded-uint8 cache serves the LEGACY
+        # preprocess path here: a repeat image skips decode+resize.
+        self._ingest_enabled = protocol.ingest_enabled(ingest)
+        self._ingest_caps: dict[str, tuple] = {}
+        self._fetch_concurrency = resolve_fetch_concurrency(fetch_concurrency)
+        self.decoded_cache = cache_lib.DecodedCache(registry=self.registry)
+        self._m_ingest = metrics_lib.ingest_gateway_metrics(self.registry)
         # Multi-replica upstream pool (serving.upstream): replica list from
         # the serving host, per-replica health + breaker, hedging policy.
         # With a single replica this degrades to exactly the PR 2 posture
@@ -453,6 +492,12 @@ class Gateway:
                 f"model tier serves no model {model or self.model!r}", 404
             )
         r.raise_for_status()
+        # Ingest negotiation rides spec discovery (GUIDE 10q): the header's
+        # presence IS the capability; an old server never sends it and this
+        # gateway stays on the tensor wire for that model.
+        replica.ingest_caps = protocol.parse_ingest_caps(
+            r.headers.get(protocol.INGEST_HEADER)
+        )
         return ModelSpec.from_json(r.text)
 
     @property
@@ -504,10 +549,26 @@ class Gateway:
                 else:
                     replica.specs[model] = spec
                     pool.reference_specs[model] = spec
+                # The reference replica's advertised ingest caps become the
+                # routed model's negotiation outcome; a stale answer on a
+                # mixed pool is healed per request (_BytesWireRejected).
+                self._ingest_caps["" if default else model] = getattr(
+                    replica, "ingest_caps", ()
+                )
                 return spec
             raise UpstreamError(
                 f"model spec discovery failed: {last_exc}"
             ) from last_exc
+
+    def supports_ingest(self, cap: str, model: str | None = None) -> bool:
+        """Negotiated ingest capability for the routed model: this gateway
+        has KDLT_INGEST on AND the model tier advertised ``cap`` at spec
+        discovery.  ``cap`` is a protocol.INGEST_CAPS member (kdlt-lint's
+        closed-vocabulary registry covers call sites)."""
+        if not self._ingest_enabled:
+            return False
+        default = model is None or model == self.model
+        return cap in self._ingest_caps.get("" if default else model, ())
 
     def _fetch_one(self, url: str):
         """url -> resized uint8 HWC image (host-side half of the pipeline),
@@ -519,11 +580,47 @@ class Gateway:
         spec = self.spec_for(model)
         t0 = time.perf_counter()
         data = preprocess.fetch_image_bytes(url)
+        image = self._decode_cached(data, spec)
+        self._m_fetch.observe(time.perf_counter() - t0)
+        return image
+
+    def _decode_cached(self, data: bytes, spec) -> "object":
+        """Decode+resize through the decoded-uint8 cache: content-addressed
+        by (payload hash, preprocess params), so a repeat image -- same
+        bytes, any URL, any model sharing the resolution/filter -- skips
+        the gateway's decode+resize CPU entirely."""
+        cache = self.decoded_cache
+        if not cache.enabled:
+            return preprocess.preprocess_bytes(
+                data, spec.input_shape[:2], filter=spec.resize_filter
+            )
+        key = cache_lib.decoded_key(
+            data, cache_lib.decoded_params(spec.input_shape, spec.resize_filter)
+        )
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
         image = preprocess.preprocess_bytes(
             data, spec.input_shape[:2], filter=spec.resize_filter
         )
-        self._m_fetch.observe(time.perf_counter() - t0)
+        cache.put(key, image)
         return image
+
+    def _fetch_one_bytes(self, url: str, trace=None, model: str | None = None):
+        """Raw-bytes ingest fetch: download only -- no decode, no resize
+        (that CPU moves to the model tier).  Returns the encoded payload;
+        the caller sniffs it before committing to the bytes wire."""
+        self.spec_for(model)  # contract discovery still gates serving
+        if trace is None:
+            t0 = time.perf_counter()
+            data = preprocess.fetch_image_bytes(url)
+            self._m_fetch.observe(time.perf_counter() - t0)
+            return data
+        with trace.span(trace_lib.SPAN_GATEWAY_PREPROCESS):
+            t0 = time.perf_counter()
+            data = preprocess.fetch_image_bytes(url)
+            self._m_fetch.observe(time.perf_counter() - t0)
+            return data
 
     def _fetch_one_traced(self, url: str, trace=None, model: str | None = None):
         """_fetch_one under a ``gateway.preprocess`` span.  Kept separate so
@@ -576,11 +673,11 @@ class Gateway:
 
     def _post_once(self, replica, body, request_id, deadline, timeout,
                    span_id: str = "", model: str | None = None,
-                   priority: str | None = None):
+                   priority: str | None = None, content_type: str | None = None):
         """One upstream POST to one replica (headers re-measured now)."""
         if self._faults is not None:
             self._faults.fire("gateway.upstream")
-        headers = {"Content-Type": protocol.MSGPACK_CONTENT_TYPE}
+        headers = {"Content-Type": content_type or protocol.MSGPACK_CONTENT_TYPE}
         if request_id:  # cross-tier trace propagation
             headers[REQUEST_ID_HEADER] = request_id
         if span_id:  # this attempt's span: the model tier's root parent
@@ -598,7 +695,8 @@ class Gateway:
 
     def _attempt_traced(self, replica, body, request_id, deadline, timeout,
                         trace, role: str, model: str | None = None,
-                        priority: str | None = None):
+                        priority: str | None = None,
+                        content_type: str | None = None):
         """One upstream POST recorded as a ``gateway.upstream`` span.
 
         Returns ``(response, span)``; on failure records the span with the
@@ -610,14 +708,14 @@ class Gateway:
         if trace is None:
             return self._post_once(
                 replica, body, request_id, deadline, timeout, model=model,
-                priority=priority,
+                priority=priority, content_type=content_type,
             ), None
         sid = trace_lib.new_span_id()
         w0 = trace_lib.now_s()
         try:
             r = self._post_once(
                 replica, body, request_id, deadline, timeout, span_id=sid,
-                model=model, priority=priority,
+                model=model, priority=priority, content_type=content_type,
             )
         except Exception as e:
             trace.tracer.record(
@@ -636,7 +734,7 @@ class Gateway:
     def _post_hedged(
         self, primary, body, request_id, deadline, timeout, tried,
         trace=None, role: str = "primary", model: str | None = None,
-        priority: str | None = None,
+        priority: str | None = None, content_type: str | None = None,
     ):
         """POST with a deadline-budget-aware hedged second attempt.
 
@@ -671,7 +769,7 @@ class Gateway:
         if not hedgeable:
             r, span = self._attempt_traced(
                 primary, body, request_id, deadline, timeout, trace, role,
-                model=model, priority=priority,
+                model=model, priority=priority, content_type=content_type,
             )
             if span is not None:
                 span.tags["winner"] = True
@@ -684,7 +782,7 @@ class Gateway:
             try:
                 r, span = self._attempt_traced(
                     rep, body, request_id, deadline, timeout, trace, rep_role,
-                    model=model, priority=priority,
+                    model=model, priority=priority, content_type=content_type,
                 )
                 results.put((rep, r, None, span))
             except Exception as e:  # noqa: BLE001 - reported via the queue
@@ -796,7 +894,47 @@ class Gateway:
         model: str | None = None,
         priority: str | None = None,
     ) -> tuple[list, list[str]]:
-        """uint8 (N,H,W,C) -> (logit rows, labels) via the model tier.
+        """uint8 (N,H,W,C) -> (logit rows, labels) via the legacy tensor
+        wire (msgpack uint8)."""
+        return self._predict_wire(
+            protocol.encode_predict_request(images), images.shape[0],
+            request_id, deadline, trace, model, priority,
+        )
+
+    def _predict_bytes(
+        self,
+        blobs: list[bytes],
+        request_id: str = "",
+        deadline: Deadline | None = None,
+        trace=None,
+        model: str | None = None,
+        priority: str | None = None,
+    ) -> tuple[list, list[str]]:
+        """Encoded JPEG/PNG blobs -> (logit rows, labels) via the raw-bytes
+        ingest wire (GUIDE 10q): the model tier decodes.  Raises
+        _BytesWireRejected on an upstream 400/415 so the caller can decode
+        locally and resend on the tensor wire (mixed-pool rollout)."""
+        body = protocol.encode_bytes_predict_request(blobs)
+        self._m_ingest["bytes_requests"].inc()
+        self._m_ingest["wire_bytes"].inc(len(body))
+        return self._predict_wire(
+            body, len(blobs), request_id, deadline, trace, model, priority,
+            content_type=protocol.BYTES_CONTENT_TYPE,
+        )
+
+    def _predict_wire(
+        self,
+        body: bytes,
+        n_images: int,
+        request_id: str = "",
+        deadline: Deadline | None = None,
+        trace=None,
+        model: str | None = None,
+        priority: str | None = None,
+        content_type: str | None = None,
+    ) -> tuple[list, list[str]]:
+        """One encoded request body -> (logit rows, labels) via the model
+        tier; the shared upstream engine for both wire formats.
 
         Failure policy over the replica pool (serving.upstream):
 
@@ -822,11 +960,10 @@ class Gateway:
 
         pool = self.pool
         gate = self.admission.enabled
-        body = protocol.encode_predict_request(images)
         # (connect, read) pair: only the READ budget scales with batch size;
         # an unreachable model tier should still fail fast at connect.
         base_read = (
-            PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(0, images.shape[0] - 1)
+            PREDICT_TIMEOUT_S + PER_IMAGE_TIMEOUT_S * max(0, n_images - 1)
         )
         tried: list = []
         retried_503 = False
@@ -867,7 +1004,7 @@ class Gateway:
                     replica, body, request_id, deadline, timeout, tried,
                     trace=trace,
                     role="failover" if tried else "primary",
-                    model=model, priority=priority,
+                    model=model, priority=priority, content_type=content_type,
                 )
             except (
                 requests.RequestException,
@@ -932,6 +1069,16 @@ class Gateway:
             time.sleep(UPSTREAM_RETRY_BACKOFF_S)
             tried.remove(replica)  # the backoff retry re-targets this replica
         if r.status_code != 200:
+            if (
+                content_type == protocol.BYTES_CONTENT_TYPE
+                and r.status_code in (400, 415)
+            ):
+                # The bytes wire was negotiated but THIS replica rejected
+                # it (old code, or KDLT_INGEST flipped off after
+                # discovery).  Signal the caller to decode locally and
+                # resend on the tensor wire -- a rollout seam, never a
+                # client-visible error.
+                raise _BytesWireRejected(r.text[:200])
             raise self._status_error(r)
         if self.cache is not None:
             # Learn the serving artifact's identity from the response: a
@@ -966,6 +1113,16 @@ class Gateway:
         non-default served model (multi-model registry).  ``priority``
         travels upstream on the direct path; micro-batched flushes mix
         classes, so a coalesced upstream POST carries none."""
+        if self._ingest_enabled:
+            self.spec_for(model)  # negotiation rides spec discovery
+            if self.supports_ingest(protocol.INGEST_BYTES_CAP, model):
+                # Raw-bytes wire (GUIDE 10q).  Bypasses the microbatcher:
+                # the upstream POST already carries compact encoded bytes,
+                # so coalescing would only add queueing delay.
+                return self._apply_model_bytes(
+                    url, request_id, deadline, trace, model, priority
+                )
+            self._m_ingest["fallbacks"]["negotiation"].inc()
         image = self._fetch_one_traced(url, trace, model=model)
         microbatcher = self._microbatcher_for(model)
         if microbatcher is not None:
@@ -989,6 +1146,36 @@ class Gateway:
             return dict(zip(labels, map(float, row)))
         logits, labels = self._predict_batch(
             image[None], request_id, deadline, trace, model=model,
+            priority=priority,
+        )
+        return dict(zip(labels, map(float, logits[0])))
+
+    def _apply_model_bytes(
+        self, url, request_id, deadline, trace, model, priority,
+    ) -> dict[str, float]:
+        """apply_model over the raw-bytes ingest wire, with the per-request
+        fallbacks (GUIDE 10q): an unsniffable blob (reason "format") or a
+        replica that rejects the wire (reason "rejected") decodes at the
+        gateway and resends the SAME fetched bytes on the tensor wire --
+        never a second download, never a client-visible seam."""
+        import numpy as np
+
+        spec = self.spec_for(model)
+        blob = self._fetch_one_bytes(url, trace, model)
+        if protocol.sniff_image_format(blob) is not None:
+            try:
+                logits, labels = self._predict_bytes(
+                    [blob], request_id, deadline, trace, model=model,
+                    priority=priority,
+                )
+                return dict(zip(labels, map(float, logits[0])))
+            except _BytesWireRejected:
+                self._m_ingest["fallbacks"]["rejected"].inc()
+        else:
+            self._m_ingest["fallbacks"]["format"].inc()
+        image = self._decode_cached(blob, spec)
+        logits, labels = self._predict_batch(
+            np.asarray(image)[None], request_id, deadline, trace, model=model,
             priority=priority,
         )
         return dict(zip(labels, map(float, logits[0])))
@@ -1020,7 +1207,15 @@ class Gateway:
                 f"{len(urls)} urls exceeds the {MAX_URLS_PER_REQUEST}-url limit"
             )
         self.spec_for(model)  # discover contract FIRST: outage => 502, not 200
-        with ThreadPoolExecutor(max_workers=min(len(urls), MAX_BATCH_FETCHERS)) as ex:
+        if self._ingest_enabled:
+            if self.supports_ingest(protocol.INGEST_BYTES_CAP, model):
+                return self._apply_model_batch_bytes(
+                    urls, request_id, deadline, trace, model, priority
+                )
+            self._m_ingest["fallbacks"]["negotiation"].inc()
+        with ThreadPoolExecutor(
+            max_workers=min(len(urls), self._fetch_concurrency)
+        ) as ex:
             fetched = list(
                 ex.map(lambda u: self._fetch_one_safe(u, trace, model), urls)
             )
@@ -1037,6 +1232,74 @@ class Gateway:
             )
             for row, (i, _) in enumerate(good):
                 results[i] = dict(zip(labels, map(float, logits[row])))
+        return results
+
+    def _apply_model_batch_bytes(
+        self, urls, request_id, deadline, trace, model, priority,
+    ) -> list[dict]:
+        """apply_model_batch over the raw-bytes ingest wire.
+
+        Wire choice is per REQUEST: all sniffable blobs -> one bytes POST;
+        any exotic blob drops the whole request to the tensor wire (reason
+        "format") so the batch stays one upstream flight either way, and a
+        _BytesWireRejected replica gets the tensor resend (reason
+        "rejected").  Per-URL failure semantics match the legacy path: a
+        bad download or undecodable blob fails only its own entry."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        import numpy as np
+
+        spec = self.spec_for(model)
+
+        def fetch(u):
+            try:
+                return self._fetch_one_bytes(u, trace, model), None
+            except UpstreamError:
+                raise  # model-tier trouble fails the request, not the URL
+            except Exception as e:  # noqa: BLE001 - per-URL failure
+                return None, str(e)
+
+        with ThreadPoolExecutor(
+            max_workers=min(len(urls), self._fetch_concurrency)
+        ) as ex:
+            fetched = list(ex.map(fetch, urls))
+        good = [(i, blob) for i, (blob, _) in enumerate(fetched) if blob is not None]
+        results: list[dict] = [
+            {"error": err} if err is not None else {} for _, err in fetched
+        ]
+        if not good:
+            return results
+        logits = labels = None
+        if all(protocol.sniff_image_format(b) is not None for _, b in good):
+            try:
+                logits, labels = self._predict_bytes(
+                    [b for _, b in good], request_id, deadline, trace,
+                    model=model, priority=priority,
+                )
+            except _BytesWireRejected:
+                self._m_ingest["fallbacks"]["rejected"].inc()
+        else:
+            self._m_ingest["fallbacks"]["format"].inc()
+        if logits is None:
+            # Tensor-wire fallback: decode the already-fetched bytes here
+            # (through the decoded cache); a blob that fails to decode
+            # fails only its own entry, like a bad URL.
+            keep, images = [], []
+            for i, blob in good:
+                try:
+                    images.append(self._decode_cached(blob, spec))
+                    keep.append(i)
+                except Exception as e:  # noqa: BLE001 - per-URL failure
+                    results[i] = {"error": str(e)}
+            if not keep:
+                return results
+            good = [(i, None) for i in keep]
+            logits, labels = self._predict_batch(
+                np.stack(images), request_id, deadline, trace, model=model,
+                priority=priority,
+            )
+        for row, (i, _) in enumerate(good):
+            results[i] = dict(zip(labels, map(float, logits[row])))
         return results
 
     def _fetch_one_safe(self, url: str, trace=None, model: str | None = None):
@@ -1128,12 +1391,17 @@ class Gateway:
         return 404, b'{"error": "not found"}', "application/json"
 
     def _cache_debug(self) -> dict:
+        # "decoded" is the decoded-uint8 tier (content-addressed, GUIDE
+        # 10q) -- independent of the response cache, so it reports even
+        # when KDLT_CACHE=0 disables the response tier.
+        decoded = {"decoded": self.decoded_cache.stats()}
         if self.cache is None:
-            return {"enabled": False}
+            return {"enabled": False, **decoded}
         return {
             "enabled": True,
             **self.cache.stats(),
             **self._singleflight.stats(),
+            **decoded,
         }
 
     def _brownout_debug(self) -> dict:

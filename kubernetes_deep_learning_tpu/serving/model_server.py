@@ -373,6 +373,8 @@ class ModelServer:
         incident_dedup_s: float | None = None,
         decode: bool | None = None,
         decode_continuous: bool = True,
+        ingest: bool | None = None,
+        decode_pool: int | None = None,
     ):
         # request_log: one traced stdout line per predict (rid, model, batch,
         # status, duration) -- the model-tier half of the gateway's
@@ -460,6 +462,26 @@ class ModelServer:
             profiler=self._incident_profile,
         )
         self.recorder.add_snapshot_provider("slo", self.slo.debug_payload)
+        # Raw-bytes ingest wire (GUIDE 10q): when enabled (KDLT_INGEST,
+        # default on; ``ingest`` arg overrides), the spec-discovery GET
+        # advertises the capability via X-Kdlt-Ingest and :predict accepts
+        # the packed-encoded-blobs content type, decoding at THIS tier on
+        # a GIL-released thread pool (KDLT_DECODE_POOL / ``decode_pool``).
+        # The decoded-uint8 cache is content-addressed -- (payload hash,
+        # preprocess params) -- so repeat images skip decode+resize across
+        # models and across the wire format.
+        from kubernetes_deep_learning_tpu.ops import preprocess as preprocess_lib
+        from kubernetes_deep_learning_tpu.serving import cache as cache_lib
+        from kubernetes_deep_learning_tpu.serving import protocol as protocol_lib
+
+        self._ingest_enabled = protocol_lib.ingest_enabled(ingest)
+        self._ingest_decoder = preprocess_lib.BatchDecoder(decode_pool)
+        self._decoded_cache = cache_lib.DecodedCache(registry=self.registry)
+        self._m_ingest = (
+            metrics_lib.ingest_server_metrics(self.registry)
+            if self._ingest_enabled
+            else None
+        )
         self.model_root = model_root
         self._buckets = buckets
         self._max_delay_ms = max_delay_ms
@@ -662,6 +684,72 @@ class ModelServer:
             target=loop, name="kdlt-version-watcher", daemon=True
         )
         self._watcher.start()
+
+    # --- raw-bytes ingest (GUIDE 10q) --------------------------------------
+
+    def _decode_blobs(self, shape, resize_filter: str, blobs: list[bytes]) -> np.ndarray:
+        """Bytes-wire decode stage: encoded blobs -> uint8 (N,H,W,C) batch
+        at ``shape`` (the model's input resolution, or the staging
+        resolution under KDLT_INGEST_DEVICE_RESIZE), through the
+        decoded-uint8 cache.
+
+        Cache keys are (content hash, resolved preprocess params): an
+        identical image hits across models sharing a resolution/filter and
+        across repeat requests, skipping decode+resize entirely.  Misses
+        fan out on the GIL-released decode pool; a corrupt blob raises
+        ValueError (-> 400, the client's error).
+        """
+        from kubernetes_deep_learning_tpu.serving import cache as cache_lib
+
+        t0 = time.perf_counter()
+        params = cache_lib.decoded_params(shape, resize_filter)
+        keys = [cache_lib.decoded_key(b, params) for b in blobs]
+        out: list = [self._decoded_cache.get(k) for k in keys]
+        miss = [i for i, arr in enumerate(out) if arr is None]
+        if miss:
+            decoded = self._ingest_decoder.decode_batch(
+                [blobs[i] for i in miss], shape[:2], filter=resize_filter,
+            )
+            for j, i in enumerate(miss):
+                self._decoded_cache.put(keys[i], decoded[j])
+                out[i] = decoded[j]
+        images = np.stack(out)
+        if self._m_ingest is not None:
+            self._m_ingest["decoded_images"].inc(len(blobs))
+            self._m_ingest["decode_seconds"].observe(time.perf_counter() - t0)
+        return images
+
+    def _predict_encoded(self, model, blobs: list[bytes], trace=None) -> np.ndarray:
+        """Cross-host bytes shortcut: engines exposing predict_encoded_async
+        (CrossHostEngine) get the wire's encoded blobs verbatim, so the
+        fleet broadcast carries compact JPEG/PNG bytes instead of the
+        padded uint8 tensor; decode happens once per process, fleet-wide
+        deterministic.  Chunked to the bucket ladder like the serial
+        engine path."""
+        eng = model.engine
+        max_b = eng.max_batch
+        traces = (trace,) if trace is not None else ()
+        outs = []
+        for i in range(0, len(blobs), max_b):
+            handle, n = eng.predict_encoded_async(blobs[i : i + max_b], traces=traces)
+            outs.append(np.asarray(handle)[:n])
+        if self._m_ingest is not None:
+            self._m_ingest["decoded_images"].inc(len(blobs))
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
+
+    def _predict_staged(self, model, images: np.ndarray) -> np.ndarray:
+        """Device-resize staging dispatch (KDLT_INGEST_DEVICE_RESIZE):
+        staging-resolution uint8 batches go straight to the engine's fused
+        resize+forward program -- the batcher/scheduler lanes carry
+        input_shape tensors only, so this opt-in path bypasses them
+        (chunked to the bucket ladder, serial like the fallback path)."""
+        eng = model.engine
+        max_b = eng.max_batch
+        outs = []
+        for i in range(0, images.shape[0], max_b):
+            handle, n = eng.predict_ingest_async(images[i : i + max_b])
+            outs.append(np.asarray(handle)[:n])
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     # --- HTTP plumbing -----------------------------------------------------
 
@@ -882,8 +970,20 @@ class ModelServer:
                     model = server.models.get(m.group(1))
                     if model is None:
                         return self._send_json(404, {"error": f"no model {m.group(1)!r}"})
+                    from kubernetes_deep_learning_tpu.serving import protocol
+
+                    # Spec discovery doubles as the ingest negotiation
+                    # (GUIDE 10q): the header's presence is the
+                    # capability; an old server simply never sends it and
+                    # a new gateway stays on the tensor wire.
+                    ingest_headers = (
+                        {protocol.INGEST_HEADER: protocol.INGEST_BYTES_CAP}
+                        if server._ingest_enabled
+                        else None
+                    )
                     return self._send(
-                        200, model.artifact.spec.to_json().encode(), "application/json"
+                        200, model.artifact.spec.to_json().encode(),
+                        "application/json", headers=ingest_headers,
                     )
                 self._send_json(404, {"error": "not found"})
 
@@ -983,24 +1083,90 @@ class ModelServer:
                         body = self.rfile.read(length)
                         self._body_consumed = True
                         ctype = self.headers.get("Content-Type", "")
-                        images = protocol.decode_predict_request(body, ctype)
-                    if images.ndim == 3:
-                        images = images[None]
-                    if images.shape[1:] != spec.input_shape:
-                        raise ValueError(
-                            f"input shape {images.shape[1:]} != {spec.input_shape}"
+                        encoded_wire = (
+                            ctype.split(";")[0].strip()
+                            == protocol.BYTES_CONTENT_TYPE
                         )
-                    if images.shape[0] > MAX_IMAGES_PER_REQUEST:
-                        raise ValueError(
-                            f"batch {images.shape[0]} exceeds the "
-                            f"{MAX_IMAGES_PER_REQUEST}-image request limit"
+                        if not encoded_wire:
+                            images = protocol.decode_predict_request(body, ctype)
+                    if encoded_wire:
+                        # Raw-bytes ingest wire (GUIDE 10q): the payload is
+                        # the packed encoded JPEG/PNG blobs; decode happens
+                        # HERE, at the model tier, on the GIL-released pool
+                        # (through the decoded-uint8 cache), instead of at
+                        # the gateway fan-in.  A disabled server 400s --
+                        # the gateway's negotiation normally prevents this,
+                        # and on a stale-negotiation race it decodes and
+                        # resends on the tensor wire.
+                        if not server._ingest_enabled:
+                            raise ValueError(
+                                "raw-bytes ingest is disabled on this "
+                                f"server (set {protocol.INGEST_ENV}=1 or "
+                                "use the tensor wire)"
+                            )
+                        blobs = protocol.decode_bytes_predict_request(
+                            body, max_images=MAX_IMAGES_PER_REQUEST
                         )
-                    batch = images.shape[0]
-                    with rt.span(trace_lib.SPAN_SERVER_PREDICT, batch=batch) as pt:
-                        logits = model.predict(
-                            images, deadline=deadline, trace=pt,
-                            priority=priority,
+                        batch = len(blobs)
+                        src_shape = tuple(
+                            getattr(
+                                model.engine, "ingest_source_shape",
+                                spec.input_shape,
+                            )
                         )
+                        if hasattr(model.engine, "predict_encoded_async"):
+                            # Cross-host: blobs ride the fleet broadcast
+                            # verbatim; decode is inside the engine round.
+                            with rt.span(
+                                trace_lib.SPAN_SERVER_PREDICT, batch=batch
+                            ) as pt:
+                                logits = server._predict_encoded(
+                                    model, blobs, trace=pt
+                                )
+                            images = None
+                        elif src_shape != tuple(spec.input_shape):
+                            # Device-resize staging: decode stops at the
+                            # staging resolution; the engine's fused
+                            # program resizes on device ahead of the
+                            # forward.
+                            with rt.span(
+                                trace_lib.SPAN_SERVER_INGEST_DECODE,
+                                images=batch, bytes=length,
+                            ):
+                                staged = server._decode_blobs(
+                                    src_shape, spec.resize_filter, blobs
+                                )
+                            with rt.span(
+                                trace_lib.SPAN_SERVER_PREDICT, batch=batch
+                            ):
+                                logits = server._predict_staged(model, staged)
+                            images = None
+                        else:
+                            with rt.span(
+                                trace_lib.SPAN_SERVER_INGEST_DECODE,
+                                images=batch, bytes=length,
+                            ):
+                                images = server._decode_blobs(
+                                    spec.input_shape, spec.resize_filter, blobs
+                                )
+                    if images is not None:
+                        if images.ndim == 3:
+                            images = images[None]
+                        if images.shape[1:] != spec.input_shape:
+                            raise ValueError(
+                                f"input shape {images.shape[1:]} != {spec.input_shape}"
+                            )
+                        if images.shape[0] > MAX_IMAGES_PER_REQUEST:
+                            raise ValueError(
+                                f"batch {images.shape[0]} exceeds the "
+                                f"{MAX_IMAGES_PER_REQUEST}-image request limit"
+                            )
+                        batch = images.shape[0]
+                        with rt.span(trace_lib.SPAN_SERVER_PREDICT, batch=batch) as pt:
+                            logits = model.predict(
+                                images, deadline=deadline, trace=pt,
+                                priority=priority,
+                            )
                     out, out_ctype = protocol.encode_predict_response(
                         logits, spec.labels, ctype
                     )

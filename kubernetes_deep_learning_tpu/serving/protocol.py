@@ -17,6 +17,7 @@ debuggability with curl.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any
 
 import msgpack
@@ -24,6 +25,114 @@ import numpy as np
 
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
 JSON_CONTENT_TYPE = "application/json"
+
+# Raw-encoded-bytes ingest wire (GUIDE 10q): the request body carries the
+# fetched JPEG/PNG bytes VERBATIM (msgpack list of bin blobs) and the model
+# tier decodes+resizes them itself -- the wire cost per image is the encoded
+# payload size, not a materialized uint8 tensor, and the fan-in gateway pays
+# no per-image decode CPU.  Strictly opt-in both ways: a server advertises
+# the capability on its spec-discovery response (INGEST_HEADER below) and a
+# gateway only sends this content type to a tier that advertised it, so a
+# mixed-version deployment degrades to the legacy tensor wire, never to an
+# error.
+BYTES_CONTENT_TYPE = "application/x-kdlt-image-bytes"
+
+# Ingest-capability negotiation, carried on the existing spec-discovery
+# handshake: the model tier stamps GET /v1/models/<name> responses with
+# this header listing its ingest capabilities (comma-separated members of
+# INGEST_CAPS); the gateway records it per model when it fetches the spec.
+# An absent header (an old server) means tensor-wire only.  The capability
+# vocabulary is CLOSED (kdlt-lint's closed-vocab pass keys on INGEST_CAPS):
+# negotiation must never grow ad-hoc tokens two tiers spell differently.
+INGEST_HEADER = "X-Kdlt-Ingest"
+INGEST_BYTES_CAP = "bytes"
+INGEST_CAPS = (INGEST_BYTES_CAP,)
+
+# KDLT_INGEST gates the whole raw-bytes path on either tier: the server
+# stops advertising (and accepting) the bytes content type, the gateway
+# stops sending it.  Default ON -- negotiation already protects
+# mixed-version fleets, so the knob is a rollback lever, not a ramp.
+INGEST_ENV = "KDLT_INGEST"
+
+# Per-blob byte bound on the decode side, mirroring the gateway's fetch
+# bound (ops.preprocess.MAX_FETCH_BYTES): the tiers are separate processes
+# and the model tier must bound memory on its own evidence.
+MAX_ENCODED_IMAGE_BYTES = 32 * 1024 * 1024
+
+# JPEG/PNG magic prefixes: the gateway's per-request fallback sniff.  Only
+# payloads positively identified as one of the two supported container
+# formats ride the bytes wire; anything exotic decodes at the gateway and
+# falls back to the tensor wire for that request.
+_JPEG_MAGIC = b"\xff\xd8\xff"
+_PNG_MAGIC = b"\x89PNG\r\n\x1a\n"
+
+
+def ingest_enabled(explicit: bool | None = None) -> bool:
+    """Explicit arg > $KDLT_INGEST > enabled-by-default (the kill switch
+    reverts both tiers to the legacy tensor-only wire)."""
+    if explicit is not None:
+        return bool(explicit)
+    raw = os.environ.get(INGEST_ENV, "").strip().lower()
+    return raw not in ("0", "false", "off", "no")
+
+
+def parse_ingest_caps(raw: str | None) -> tuple[str, ...]:
+    """Normalize an X-Kdlt-Ingest header into known capability tokens;
+    unknown tokens are dropped (an old gateway meeting a future server
+    must only ever see capabilities it understands)."""
+    if not raw:
+        return ()
+    return tuple(
+        tok for tok in (t.strip().lower() for t in raw.split(","))
+        if tok in INGEST_CAPS
+    )
+
+
+def sniff_image_format(data: bytes) -> str | None:
+    """JPEG/PNG container sniff by magic bytes; None for anything else
+    (the per-request tensor-wire fallback trigger)."""
+    if data.startswith(_JPEG_MAGIC):
+        return "jpeg"
+    if data.startswith(_PNG_MAGIC):
+        return "png"
+    return None
+
+
+def encode_bytes_predict_request(blobs: list[bytes]) -> bytes:
+    """Encoded image blobs -> msgpack request body (the bytes wire)."""
+    return msgpack.packb({"images": [bytes(b) for b in blobs]})
+
+
+def decode_bytes_predict_request(
+    body: bytes, max_images: int | None = None,
+) -> list[bytes]:
+    """Inverse of :func:`encode_bytes_predict_request`, with the bounds a
+    network-facing decoder needs: a list of non-empty bin blobs, each
+    under MAX_ENCODED_IMAGE_BYTES, optionally capped in count.  Raises
+    ValueError (the transports map it to a 400 -- malformed input is the
+    CLIENT's error, never a 500)."""
+    try:
+        msg = msgpack.unpackb(body)
+    except Exception as e:  # noqa: BLE001 - mapped to 400 by the caller
+        raise ValueError(f"invalid msgpack body: {e}") from e
+    if not isinstance(msg, dict) or "images" not in msg:
+        raise ValueError('bytes request must be a msgpack map with "images"')
+    blobs = msg["images"]
+    if not isinstance(blobs, list) or not blobs:
+        raise ValueError('"images" must be a non-empty list of image blobs')
+    if max_images is not None and len(blobs) > max_images:
+        raise ValueError(
+            f"{len(blobs)} images exceeds the {max_images}-image limit"
+        )
+    for i, blob in enumerate(blobs):
+        if not isinstance(blob, (bytes, bytearray)) or not blob:
+            raise ValueError(f"image {i} is not a non-empty binary blob")
+        if len(blob) > MAX_ENCODED_IMAGE_BYTES:
+            raise ValueError(
+                f"image {i} ({len(blob)} bytes) exceeds the "
+                f"{MAX_ENCODED_IMAGE_BYTES}-byte per-image limit"
+            )
+    return [bytes(b) for b in blobs]
 
 # The generative lane's streamed response body: Server-Sent Events over
 # HTTP/1.1 chunked transfer.  Every streamed token is one ``data:`` event;

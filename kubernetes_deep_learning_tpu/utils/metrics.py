@@ -716,6 +716,99 @@ def cache_metrics(registry: "Registry") -> dict:
     }
 
 
+# Decoded-uint8 cache tier (serving.cache.DecodedCache): content-addressed
+# decode results shared across models.  kdlt_cache_decoded_* rides the
+# kdlt_cache_ central prefix, so it is minted HERE and nowhere else.
+def cache_decoded_metrics(registry: "Registry") -> dict:
+    """The decoded-uint8 cache tier's series (kdlt_cache_decoded_*).
+
+    Keys are (payload content hash, resolved preprocess params), so a hit
+    means a previously decoded image's pixels were reused -- across
+    requests AND across models sharing an input contract -- skipping the
+    JPEG/PNG decode + resize entirely.  Entries are content-addressed and
+    therefore immutable: there is no TTL and no artifact invalidation,
+    only the LRU byte budget (KDLT_CACHE_DECODED_MB)."""
+    return {
+        "hits": registry.counter(
+            "kdlt_cache_decoded_hits_total",
+            "decode-stage lookups served a previously decoded uint8 tensor "
+            "(no JPEG/PNG decode, no resize)",
+        ),
+        "misses": registry.counter(
+            "kdlt_cache_decoded_misses_total",
+            "decode-stage lookups that paid the full decode+resize",
+        ),
+        "resident": registry.gauge(
+            "kdlt_cache_decoded_resident_bytes",
+            "decoded uint8 tensor bytes currently held by the decoded tier",
+        ),
+        "entries": registry.gauge(
+            "kdlt_cache_decoded_entries",
+            "entries currently held by the decoded tier",
+        ),
+        "evictions": registry.counter(
+            "kdlt_cache_decoded_evictions_total",
+            "decoded entries evicted to fit the KDLT_CACHE_DECODED_MB "
+            "byte budget (content-addressed entries never expire; LRU is "
+            "the only way out)",
+        ),
+    }
+
+
+# Raw-bytes ingest wire (serving/protocol + GUIDE 10q).  The ``reason``
+# label's value set is exactly this tuple (bounded by construction); the
+# kdlt_ingest_ prefix is confined to this module by kdlt-lint.
+INGEST_FALLBACK_REASONS = (
+    ("format", "payload failed the JPEG/PNG magic-byte sniff (exotic "
+               "format decodes at the gateway, rides the tensor wire)"),
+    ("negotiation", "the model tier did not advertise the bytes capability "
+                    "on its spec response (old server or KDLT_INGEST=0)"),
+    ("rejected", "a bytes-wire POST came back 4xx and the request was "
+                 "re-sent decoded on the legacy tensor wire"),
+)
+
+
+def ingest_gateway_metrics(registry: "Registry") -> dict:
+    """The gateway tier's raw-bytes ingest series (kdlt_ingest_*): how
+    much traffic rides the bytes wire, why the rest fell back, and the
+    wire bytes actually shipped (the payload-diet receipt bench.py
+    --ingest-ab cross-checks)."""
+    return {
+        "bytes_requests": registry.counter(
+            "kdlt_ingest_bytes_requests_total",
+            "upstream predict calls sent on the raw-bytes wire",
+        ),
+        "wire_bytes": registry.counter(
+            "kdlt_ingest_wire_bytes_total",
+            "request-body bytes shipped on the raw-bytes wire",
+        ),
+        "fallbacks": {
+            reason: registry.with_labels(reason=reason).counter(
+                "kdlt_ingest_fallbacks_total", help
+            )
+            for reason, help in INGEST_FALLBACK_REASONS
+        },
+    }
+
+
+def ingest_server_metrics(registry: "Registry") -> dict:
+    """The model tier's decode-stage series (kdlt_ingest_*): images
+    decoded at this tier and the per-batch decode latency (the stage a
+    trace waterfall shows as server.ingest_decode)."""
+    return {
+        "decoded_images": registry.counter(
+            "kdlt_ingest_decoded_images_total",
+            "images decoded+resized by the model tier's decode stage",
+        ),
+        "decode_seconds": registry.histogram(
+            "kdlt_ingest_decode_seconds",
+            "wall seconds per bytes-wire batch in the thread-pooled "
+            "decode stage",
+            buckets=PIPELINE_STAGE_BUCKETS,
+        ),
+    }
+
+
 # Quantization serving state (ops.quantize + runtime.engine).  The scheme
 # label's value set is exactly this tuple (bounded by construction); minted
 # HERE and nowhere else -- tools/check_metrics.py confines the kdlt_quant_

@@ -64,6 +64,11 @@ SPAN_GATEWAY_UPSTREAM = "gateway.upstream"
 SPAN_SERVER_REQUEST = "server.request"
 SPAN_SERVER_ADMISSION = "server.admission"
 SPAN_SERVER_DECODE = "server.decode"
+# Raw-bytes ingest wire (GUIDE 10q): the model tier's image-decode stage --
+# thread-pooled JPEG/PNG decode + resize of the blobs a bytes-wire request
+# carried.  Nested inside server.decode's request-parse span so a waterfall
+# separates wire parse cost from pixel decode cost.
+SPAN_SERVER_INGEST_DECODE = "server.ingest_decode"
 SPAN_SERVER_PREDICT = "server.predict"
 SPAN_ENGINE_PREDICT = "engine.predict"
 SPAN_BATCHER_QUEUE_WAIT = "batcher.queue_wait"
@@ -96,6 +101,7 @@ SPAN_NAMES = frozenset({
     SPAN_SERVER_REQUEST,
     SPAN_SERVER_ADMISSION,
     SPAN_SERVER_DECODE,
+    SPAN_SERVER_INGEST_DECODE,
     SPAN_SERVER_PREDICT,
     SPAN_ENGINE_PREDICT,
     SPAN_BATCHER_QUEUE_WAIT,

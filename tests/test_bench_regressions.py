@@ -137,6 +137,7 @@ def test_dry_run_covers_the_auxiliary_modes():
         (["--tenant-ab", "5"], "tenant_ab"),
         (["--incident-ab", "6"], "incident_ab"),
         (["--decode-ab", "16"], "decode_ab"),
+        (["--ingest-ab", "120"], "ingest_ab"),
     ):
         proc = subprocess.run(
             [sys.executable, _BENCH, *flags, "--dry-run"],
@@ -435,6 +436,48 @@ def test_decode_ab_continuous_wins_goodput_and_stays_bit_exact():
     # The convoy effect is the mechanism: static's TTFT p99 must reflect
     # late waves queuing behind full batch drains.
     assert arms["static"]["ttft_p99_ms"] > arms["continuous"]["ttft_p99_ms"], arms
+
+
+def test_dry_run_ingest_ab_echoes_the_ingest_config():
+    # The --ingest-ab invocation surface (the raw-bytes ingest wire
+    # acceptance harness, ISSUE 20) must keep parsing and echo its
+    # resolved knobs without importing jax, binding ports, or encoding
+    # a single JPEG.
+    proc = subprocess.run(
+        [sys.executable, _BENCH, "--ingest-ab", "150", "--dry-run",
+         "--ingest-size", "512", "--ingest-input", "96",
+         "--ingest-clients", "4", "--ingest-seed", "7"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    out = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    assert out["dry_run"] is True
+    assert out["mode"] == "ingest_ab"
+    assert out["ingest"]["images"] == 150
+    assert out["ingest"]["source_px"] == 512
+    assert out["ingest"]["input_px"] == 96
+    assert out["ingest"]["clients"] == 4
+    assert out["ingest"]["seed"] == 7
+
+
+@pytest.mark.slow
+def test_ingest_ab_bytes_wire_moves_the_decode_and_keeps_parity():
+    """ISSUE 20's acceptance bar (slow: two closed-loop HTTP arms over a
+    real gateway + stub model tier): the bytes wire clears >=1.3x img/s
+    OR >=2x lower gateway CPU/image, wire bytes/image stay <=1.2x the
+    encoded blob, per-image scores are identical across wires, and the
+    bytes arm fires zero fallbacks."""
+    bench = _bench_module()
+    out, rc = bench.bench_ingest_ab(n_images=96, clients=6)
+    assert rc == 0, out
+    assert out["speedup_img_per_s"] >= 1.3 or out["cpu_ratio"] >= 2.0, out
+    assert out["wire_ratio_vs_encoded"] <= 1.2, out
+    assert out["parity_identical"] is True, out
+    assert out["used_bytes_wire"] is True, out
+    assert out["arms"]["bytes"]["errors"] == 0, out
+    assert out["arms"]["tensor"]["errors"] == 0, out
+    # The tensor arm must not have touched the bytes wire at all.
+    assert out["arms"]["tensor"]["bytes_requests"] == 0, out
 
 
 @pytest.mark.slow

@@ -321,6 +321,79 @@ def test_two_process_spmd_predict():
     assert "FOLLOWER-OK" in follower_out, follower_out[-2000:]
 
 
+_ENCODED_WORKER = r"""
+import io, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+from kubernetes_deep_learning_tpu.utils.platform import force_platform
+force_platform("cpu")
+from kubernetes_deep_learning_tpu.utils.distributed import initialize
+assert initialize()
+import jax
+import numpy as np
+from PIL import Image
+
+from kubernetes_deep_learning_tpu.modelspec import ModelSpec, register_spec
+from kubernetes_deep_learning_tpu.models import init_variables
+from kubernetes_deep_learning_tpu.ops import preprocess
+from kubernetes_deep_learning_tpu.parallel.mesh import make_mesh
+from kubernetes_deep_learning_tpu.parallel.crosshost import CrossHostForward
+
+spec = register_spec(ModelSpec(
+    name="xh-enc", family="vit-tiny", input_shape=(16, 16, 3),
+    labels=("a", "b", "c"), preprocessing="tf",
+))
+variables = init_variables(spec, seed=11)
+mesh = make_mesh(8, devices=jax.devices())
+xh = CrossHostForward(spec, mesh, variables, buckets=(4, 8))
+
+mode = sys.argv[1]
+if mode == "follower":
+    rounds = xh.follower_loop()
+    assert rounds == 2, f"expected 1 tensor + 1 encoded round, served {rounds}"
+    print("FOLLOWER-OK", flush=True)
+else:
+    rng = np.random.default_rng(0)
+    blobs = []
+    for i in range(3):
+        buf = io.BytesIO()
+        Image.fromarray(
+            rng.integers(0, 256, (16, 16, 3), np.uint8)
+        ).save(buf, format="PNG")  # lossless at input size: decode is exact
+        blobs.append(buf.getvalue())
+    dec = preprocess.BatchDecoder(workers=2)
+    decoded = dec.decode_batch(blobs, spec.input_shape[:2],
+                               filter=spec.resize_filter)
+    want = xh.predict(decoded)  # round 1: the legacy tensor wire
+    # A corrupt blob must die at the LEADER, before any broadcast: the
+    # follower's round count proves nothing reached the control channel.
+    try:
+        xh.predict_encoded_async([blobs[0], b"\xff\xd8\xffcorrupt"])
+        raise SystemExit("corrupt blob must raise at the leader")
+    except ValueError:
+        pass
+    handle, n = xh.predict_encoded_async(blobs)  # round 2: encoded wire
+    got = np.asarray(handle)[:n]
+    assert n == 3, n
+    # Same bucket, same program, followers decoded the same bytes with
+    # the same host kernels: the wires must agree bit for bit.
+    np.testing.assert_array_equal(got, want)
+    xh.shutdown()
+    print("LEADER-OK", flush=True)
+"""
+
+
+def test_two_process_encoded_broadcast_matches_tensor_wire():
+    """The raw-bytes ingest wire across a REAL 2-process fleet (GUIDE
+    10q): the leader broadcasts packed encoded blobs, every follower
+    decodes locally, and the round's logits are bit-identical to the
+    legacy tensor-wire round on the same pixels; a corrupt blob raises at
+    the leader without consuming a fleet round."""
+    leader_out, follower_out = _run_fleet(_ENCODED_WORKER)
+    assert "LEADER-OK" in leader_out, leader_out[-2000:]
+    assert "FOLLOWER-OK" in follower_out, follower_out[-2000:]
+
+
 def test_reload_round_trip():
     """Fleet-wide hot version reload: v1 predicts, RELOAD broadcast, v2
     predicts -- all against single-process references (VERDICT r2 #5)."""

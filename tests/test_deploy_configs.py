@@ -897,6 +897,87 @@ def test_incident_recorder_envs_agree_across_k8s_and_compose():
         )
 
 
+def test_ingest_envs_agree_across_k8s_and_compose():
+    """The raw-bytes ingest wiring (ISSUE 20): KDLT_INGEST rides on BOTH
+    tiers in BOTH deploy targets with agreeing values -- a gateway with
+    the wire on and a model tier without it silently pays the fallback
+    decode on every request -- plus the tier-local knobs: the model
+    tier's decode pool and the gateway's hoisted fetch fan-out.  Every
+    value must parse through the same resolvers the code uses."""
+    from kubernetes_deep_learning_tpu.ops.preprocess import (
+        DECODE_POOL_ENV,
+        resolve_decode_pool,
+    )
+    from kubernetes_deep_learning_tpu.serving.gateway import (
+        FETCH_CONCURRENCY_ENV,
+        resolve_fetch_concurrency,
+    )
+    from kubernetes_deep_learning_tpu.serving.protocol import (
+        INGEST_ENV,
+        ingest_enabled,
+    )
+
+    k8s = os.path.join(DEPLOY, "k8s")
+    (model_dep,) = _yaml_docs(os.path.join(k8s, "model-server-deployment.yaml"))
+    (gw_dep,) = _yaml_docs(os.path.join(k8s, "gateway-deployment.yaml"))
+    compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
+
+    def k8s_env(dep):
+        (container,) = dep["spec"]["template"]["spec"]["containers"]
+        return {e["name"]: str(e.get("value", "")) for e in container["env"]}
+
+    def compose_env(svc):
+        return {
+            k: str(v)
+            for k, v in compose["services"][svc]["environment"].items()
+        }
+
+    model_tier = {
+        "k8s/model-server": k8s_env(model_dep),
+        "compose/model-server": compose_env("model-server"),
+        "compose/model-server-b": compose_env("model-server-b"),
+    }
+    gateway_tier = {
+        "k8s/gateway": k8s_env(gw_dep),
+        "compose/gateway": compose_env("gateway"),
+    }
+    for tier, var in (
+        (model_tier, INGEST_ENV),
+        (model_tier, DECODE_POOL_ENV),
+        (gateway_tier, INGEST_ENV),
+        (gateway_tier, FETCH_CONCURRENCY_ENV),
+    ):
+        values = {where: env.get(var) for where, env in tier.items()}
+        assert all(v is not None for v in values.values()), (
+            f"{var} missing from some copy of the tier: {values}"
+        )
+        assert len(set(values.values())) == 1, (
+            f"{var} disagrees across the tier: {values}"
+        )
+    # The wire must be ON in both tiers (the negotiation handshake makes
+    # a half-on deployment safe, but the shipped posture is on/on), and
+    # every value must round-trip the production resolvers.
+    ingest_value = model_tier["k8s/model-server"][INGEST_ENV]
+    assert ingest_value == gateway_tier["k8s/gateway"][INGEST_ENV], (
+        "the two tiers ship disagreeing KDLT_INGEST postures"
+    )
+    os.environ[INGEST_ENV] = ingest_value
+    try:
+        assert ingest_enabled() is True, (
+            "deploys must not ship the ingest kill switch engaged"
+        )
+    finally:
+        del os.environ[INGEST_ENV]
+    pool = resolve_decode_pool(
+        int(model_tier["k8s/model-server"][DECODE_POOL_ENV])
+    )
+    assert 1 <= pool <= 64, "decode pool wired to a nonsense width"
+    fetchers = resolve_fetch_concurrency(
+        int(gateway_tier["k8s/gateway"][FETCH_CONCURRENCY_ENV])
+    )
+    assert 1 <= fetchers <= 64, "fetch fan-out wired to a nonsense width"
+
+
 def test_compose_services_reference_built_dockerfiles():
     compose = yaml.safe_load(_read(os.path.join(DEPLOY, "docker-compose.yaml")))
     for svc in compose["services"].values():

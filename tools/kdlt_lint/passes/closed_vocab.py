@@ -51,6 +51,10 @@ VOCABS = (
     ("trigger", RECORDER_MODULE, "TRIGGER_RULES"),
     # Sharding-scheme tags (registry status / GET /v1/models key on them).
     ("sharding", MESH_MODULE, "SHARDING_SCHEMES"),
+    # Ingest wire capabilities (the X-Kdlt-Ingest negotiation tokens,
+    # GUIDE 10q): gateway.supports_ingest call sites must name a
+    # registered capability.
+    ("ingest-cap", f"{PACKAGE}/serving/protocol.py", "INGEST_CAPS"),
 )
 
 # Modules whose bare self.record / self._emit / self.fire calls are
@@ -165,6 +169,9 @@ class ClosedVocabPass(LintPass):
             elif meth == "sharding_scheme":
                 if arg0 is not None:
                     member("sharding", arg0, node.lineno, "sharding scheme")
+            elif meth == "supports_ingest":
+                if arg0 is not None:
+                    member("ingest-cap", arg0, node.lineno, "ingest capability")
             elif meth == "record" and recv_tail is not None:
                 if recv_tail == "recorder" or (
                     recv == ["self"] and SELF_EMITTER_MODULES.get(mod.rel) == "event-kind"

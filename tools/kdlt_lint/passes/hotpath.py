@@ -45,6 +45,14 @@ HOT_PATH_ROOTS = (
     # async -- materialization happens once per iteration in the scheduler
     # loop (emit_tokens), never inside the step dispatch itself.
     (f"{PACKAGE}/runtime/decode.py", "DecodeEngine", "step_async"),
+    # Raw-bytes ingest (GUIDE 10q): the model tier's decode-stage entry
+    # and the engine's fused-ingest dispatch surface.  decode_batch runs
+    # pre-dispatch by design -- its intentional host materializations
+    # carry explicit suppressions in ops/preprocess.py; anything NEW that
+    # blocks on device work from these roots is flagged.
+    (f"{PACKAGE}/ops/preprocess.py", "BatchDecoder", "decode_batch"),
+    (f"{PACKAGE}/runtime/engine.py", "InferenceEngine", "predict_ingest_async"),
+    (f"{PACKAGE}/parallel/crosshost.py", "CrossHostForward", "predict_encoded_async"),
 )
 
 SYNC_NP_FUNCS = {"numpy.asarray", "numpy.array"}

@@ -29,7 +29,7 @@ CENTRAL_LABELS = {
 }
 CENTRAL_PREFIXES = (
     "kdlt_slo_", "kdlt_cache_", "kdlt_quant_", "kdlt_pool_", "kdlt_brownout_",
-    "kdlt_incident_", "kdlt_mesh_", "kdlt_decode_",
+    "kdlt_incident_", "kdlt_mesh_", "kdlt_decode_", "kdlt_ingest_",
 )
 CENTRAL_NAMES = ("kdlt_engine_warm_source",)
 METRICS_MODULE = f"{PACKAGE}.utils.metrics"
@@ -157,7 +157,8 @@ class MetricsNamingPass(LintPass):
                         node.lineno,
                         f"{head!r} minted outside "
                         "utils/metrics.py; kdlt_slo_*/kdlt_cache_*/kdlt_quant_*/"
-                        "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_*/kdlt_mesh_* "
+                        "kdlt_pool_*/kdlt_brownout_*/kdlt_incident_*/kdlt_mesh_*/"
+                        "kdlt_decode_*/kdlt_ingest_* "
                         "series (and kdlt_engine_warm_source) are minted only by "
                         "the central helpers (bounded label sets by construction)",
                     )
